@@ -1,0 +1,110 @@
+"""ray_tpu: a TPU-native distributed computing and ML framework.
+
+Public core API mirrors the reference's (``python/ray/__init__.py``):
+init/shutdown, @remote, get/put/wait, actors, placement groups -- built on a
+from-scratch runtime (see _private/) designed for JAX/XLA on Cloud TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.worker import global_worker
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+
+def init(address: Optional[str] = None, **kwargs) -> dict:
+    """Start (or connect to) a cluster. See Worker.init for options."""
+    return global_worker.init(address, **kwargs)
+
+
+def shutdown():
+    global_worker.shutdown()
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def remote(*args, **kwargs):
+    """Decorator turning a function into a RemoteFunction or a class into an
+    ActorClass.  Usable bare (@remote) or with options (@remote(num_cpus=2)).
+    """
+    if len(args) == 1 and not kwargs and (
+        callable(args[0]) or isinstance(args[0], type)
+    ):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+def put(value: Any) -> ObjectRef:
+    from ray_tpu._private.worker import get_core
+    return get_core().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    from ray_tpu._private.worker import get_core
+    core = get_core()
+    if isinstance(refs, ObjectRef):
+        return core.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or a list, got {type(refs)}")
+    if not refs:
+        return []
+    return core.get(list(refs), timeout)
+
+
+def wait(refs: List[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    from ray_tpu._private.worker import get_core
+    if not isinstance(refs, list):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return get_core().wait(refs, num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    from ray_tpu._private.worker import get_core
+    get_core().kill_actor(actor._actor_id, no_restart)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    from ray_tpu._private.worker import get_core
+    info = get_core().get_named_actor(
+        name, namespace or global_worker.namespace)
+    if info is None:
+        raise ValueError(f"no live actor named '{name}'")
+    return ActorHandle(info["actor_id"], name)
+
+
+def cluster_resources() -> Dict[str, float]:
+    from ray_tpu._private.worker import get_core
+    return get_core().gcs_request({"type": "cluster_resources"})["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    from ray_tpu._private.worker import get_core
+    return get_core().gcs_request({"type": "cluster_resources"})["available"]
+
+
+def nodes() -> List[dict]:
+    from ray_tpu._private.worker import get_core
+    return get_core().gcs_request({"type": "get_nodes"})

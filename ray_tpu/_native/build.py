@@ -1,0 +1,33 @@
+"""Builds the native runtime library on demand (no pip-installable artifacts).
+
+The .so is rebuilt whenever a source file is newer than the library, so the
+repo stays source-only and any machine with g++ self-bootstraps on import.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["object_store.cc"]
+_LIB = os.path.join(_DIR, "libray_tpu_native.so")
+_lock = threading.Lock()
+
+
+def ensure_built() -> str:
+    with _lock:
+        srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+        if os.path.exists(_LIB) and all(
+            os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs
+        ):
+            return _LIB
+        tmp = _LIB + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, *srcs, "-lpthread", "-lrt",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _LIB)
+        return _LIB

@@ -1,0 +1,703 @@
+"""CoreWorker: per-process runtime embedded in the driver and every worker.
+
+Design analog: reference ``src/ray/core_worker/`` -- CoreWorker (submit +
+execute), TaskManager (retries), ReferenceCounter (local refs), ActorManager /
+CoreWorkerDirectActorTaskSubmitter (direct ordered actor calls),
+CoreWorkerMemoryStore (small objects inline in the owner), and the Cython
+driver glue in ``python/ray/_raylet.pyx`` (execute_task loop).
+
+Threading model: one asyncio IO loop on a dedicated thread handles every
+socket; task/actor-method execution runs on a single dedicated execution
+thread (preserving actor serial semantics), with async actor methods running
+as coroutines on the IO loop.  The public API is synchronous and bridges with
+run_coroutine_threadsafe -- same shape as the reference's C++ io_service +
+Python execution thread split.
+
+Key protocol choices mirroring the reference:
+  * Normal tasks: lease a worker from the local raylet (spillback honored),
+    then push the task DIRECTLY to the leased worker (direct_task_transport.h).
+  * Actor calls: resolve the actor address via GCS once, then push calls
+    directly to the actor's worker with per-handle sequence numbers
+    (direct_actor_task_submitter.h); on disconnect, re-resolve and either
+    resubmit (restarting) or fail with ActorDiedError (dead).
+  * Small objects (<= INLINE_MAX) live in the owner's memory store and are
+    inlined into task specs / replies; large objects go through the node's
+    shared-memory store with locations registered in the GCS directory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private import object_ref as object_ref_mod
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, task_id_generator
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.plasma import PlasmaClient
+from ray_tpu._private.protocol import ConnectionLost, RpcConnection, RpcServer, connect
+from ray_tpu._private.serialization import get_context
+
+logger = logging.getLogger(__name__)
+
+INLINE_MAX = 100 * 1024  # objects at or below this ride inline (reference: 100KB)
+DEFAULT_MAX_RETRIES = 3
+
+
+def _serialize_exception(e: BaseException) -> bytes:
+    tb = traceback.format_exc()
+    try:
+        payload = cloudpickle.dumps((e, tb))
+    except Exception:
+        payload = cloudpickle.dumps(
+            (RuntimeError(f"{type(e).__name__}: {e} (original unpicklable)"), tb))
+    return payload
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        gcs_address: str,
+        raylet_address: Optional[str],
+        store_name: Optional[str],
+        node_id_hex: Optional[str],
+        job_id: str,
+        is_worker: bool = False,
+    ):
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.node_id_hex = node_id_hex
+        self.job_id = job_id
+        self.is_worker = is_worker
+        self.ser = get_context()
+
+        # object state (guarded by the IO loop: only touched from loop thread,
+        # except refcounts which use their own lock)
+        self.memory_store: Dict[str, Tuple[str, Any]] = {}
+        self.object_events: Dict[str, asyncio.Event] = {}
+        self.owned: set = set()
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[str, int] = {}
+
+        self.plasma: Optional[PlasmaClient] = None
+        if store_name:
+            self.plasma = PlasmaClient(store_name)
+
+        # actor submission state: actor_id hex -> dict
+        self.actor_state: Dict[str, dict] = {}
+        self._function_cache: Dict[str, Any] = {}
+        self._exported_functions: set = set()
+
+        # executor hooks, set by worker_main on workers
+        self.task_executor = None
+
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._loop_main,
+                                             name="rt-io", daemon=True)
+        self._started = threading.Event()
+        self._loop_thread.start()
+        self._started.wait()
+
+        self.exec_pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="rt-exec")
+        self._run(self._async_init())
+        object_ref_mod.set_refcount_sink(self)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _loop_main(self):
+        asyncio.set_event_loop(self.loop)
+        self._started.set()
+        self.loop.run_forever()
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    async def _async_init(self):
+        self.server = RpcServer(self._make_handler)
+        await self.server.start(0)
+        self.address = self.server.address
+        self.gcs = await connect(self.gcs_address, self._handle_push, name="cw->gcs")
+        self.raylet = None
+        if self.raylet_address:
+            self.raylet = await connect(self.raylet_address, self._handle_push,
+                                        name="cw->raylet")
+        self._worker_conns: Dict[str, RpcConnection] = {}
+
+    def shutdown(self):
+        try:
+            self._run(self._async_shutdown(), timeout=5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        object_ref_mod.set_refcount_sink(None)
+
+    async def _async_shutdown(self):
+        await self.server.close()
+        for c in list(self._worker_conns.values()):
+            await c.close()
+        if self.raylet:
+            await self.raylet.close()
+        await self.gcs.close()
+        if self.plasma:
+            self.plasma.close()
+            self.plasma = None
+
+    async def _handle_push(self, msg: dict):
+        if msg.get("type") == "pub":
+            return None
+        raise ValueError(f"unexpected push {msg.get('type')}")
+
+    def _make_handler(self, conn: RpcConnection):
+        async def handle(msg: dict):
+            mtype = msg["type"]
+            if mtype == "get_object":
+                return await self._h_get_object(msg)
+            if self.task_executor is not None:
+                return await self.task_executor.handle(conn, msg)
+            raise ValueError(f"core worker: unknown message {mtype}")
+        return handle
+
+    async def _h_get_object(self, msg: dict):
+        """Owner-fetch: another process resolves an object we own."""
+        oid = msg["object_id"]
+        deadline = time.monotonic() + msg.get("timeout", 300.0)
+        while oid not in self.memory_store:
+            ev = self.object_events.setdefault(oid, asyncio.Event())
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"status": "timeout"}
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return {"status": "timeout"}
+        kind, data = self.memory_store[oid]
+        if kind == "val":
+            return {"status": "inline", "data": data}
+        if kind == "err":
+            return {"status": "error", "data": data}
+        return {"status": "plasma"}
+
+    # ------------------------------------------------------------ refcounts
+
+    def add_local_ref(self, oid: ObjectID):
+        with self._ref_lock:
+            self._local_refs[oid.hex()] = self._local_refs.get(oid.hex(), 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID):
+        with self._ref_lock:
+            n = self._local_refs.get(oid.hex(), 0) - 1
+            if n > 0:
+                self._local_refs[oid.hex()] = n
+                return
+            self._local_refs.pop(oid.hex(), None)
+        if not self.loop.is_closed():
+            self.loop.call_soon_threadsafe(self._free_object, oid)
+
+    def _free_object(self, oid: ObjectID):
+        """Zero local refs: owners free the value (reference_count.h eager
+        deletion); borrowers just drop local state."""
+        h = oid.hex()
+        if h not in self.owned:
+            return
+        self.owned.discard(h)
+        entry = self.memory_store.pop(h, None)
+        self.object_events.pop(h, None)
+        if self.plasma is not None and (entry is None or entry[0] == "plasma"):
+            try:
+                if self.plasma.delete(oid):
+                    asyncio.ensure_future(self.gcs.notify({
+                        "type": "object_location_remove",
+                        "object_id": h, "node_id": self.node_id_hex}), loop=self.loop)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ put/get
+
+    def _store_local(self, oid_hex: str, kind: str, data):
+        self.memory_store[oid_hex] = (kind, data)
+        ev = self.object_events.get(oid_hex)
+        if ev is not None:
+            ev.set()
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_task_return(task_id_generator.next(), 0)
+        ser = self.ser.serialize(value)
+        ref = ObjectRef(oid, self.address)
+        self._run(self._put_serialized(oid, ser))
+        return ref
+
+    async def _put_serialized(self, oid: ObjectID, ser) -> None:
+        h = oid.hex()
+        self.owned.add(h)
+        if ser.total_size <= INLINE_MAX or self.plasma is None:
+            self._store_local(h, "val", ser.to_bytes())
+        else:
+            self.plasma.put_bytes(oid, ser.segments)
+            self._store_local(h, "plasma", None)
+            await self.gcs.request({"type": "object_location_add",
+                                    "object_id": h,
+                                    "node_id": self.node_id_hex,
+                                    "owner": self.address})
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
+        return self._run(self.get_objects_async(refs, timeout))
+
+    async def get_objects_async(self, refs: List[ObjectRef],
+                                timeout: Optional[float] = None):
+        coros = [self.get_async(r) for r in refs]
+        try:
+            if timeout is None:
+                return list(await asyncio.gather(*coros))
+            return list(await asyncio.wait_for(asyncio.gather(*coros), timeout))
+        except asyncio.TimeoutError:
+            raise rex.GetTimeoutError(
+                f"get() timed out after {timeout}s") from None
+
+    async def get_async(self, ref: ObjectRef) -> Any:
+        data = await self._resolve_bytes(ref.id, ref.owner_address)
+        return self._materialize(data)
+
+    def _materialize(self, data):
+        kind, payload = data
+        if kind == "err":
+            e, tb = cloudpickle.loads(payload)
+            if isinstance(e, rex.RayTpuError):
+                raise e
+            raise rex.TaskError(e, tb)
+        value = self.ser.deserialize(memoryview(payload))
+        return value
+
+    async def _resolve_bytes(self, oid: ObjectID, owner: str,
+                             deadline: Optional[float] = None):
+        """Resolve an object id to ('val'|'err', bytes) from anywhere."""
+        h = oid.hex()
+        while True:
+            entry = self.memory_store.get(h)
+            if entry is not None and entry[0] in ("val", "err"):
+                return entry
+            # Local shared-memory store.
+            if self.plasma is not None:
+                view = self.plasma.get(oid)
+                if view is not None:
+                    try:
+                        data = bytes(view)
+                    finally:
+                        view.release()
+                        self.plasma.release(oid)
+                    return ("val", data)
+            if entry is not None and entry[0] == "plasma":
+                ok = await self._pull_to_local(h)
+                if ok:
+                    continue
+            # Ask the owner (memory-store objects of other processes, or
+            # discover that it lives in plasma somewhere).
+            if owner and owner != self.address:
+                try:
+                    owner_conn = await self._get_worker_conn(owner)
+                    reply = await owner_conn.request(
+                        {"type": "get_object", "object_id": h}, timeout=310)
+                    if reply["status"] == "inline":
+                        return ("val", reply["data"])
+                    if reply["status"] == "error":
+                        return ("err", reply["data"])
+                    if reply["status"] == "plasma":
+                        if await self._pull_to_local(h):
+                            continue
+                except ConnectionLost:
+                    pass
+                # Owner gone; try the object directory anyway.
+                if await self._pull_to_local(h):
+                    continue
+                raise rex.ObjectLostError(
+                    f"object {h[:16]} lost: owner {owner} unreachable and no "
+                    f"copies found")
+            if owner == self.address or not owner:
+                # We own it but it is not ready yet -> wait for task completion.
+                ev = self.object_events.setdefault(h, asyncio.Event())
+                await ev.wait()
+                ev.clear()
+                continue
+
+    async def _pull_to_local(self, oid_hex: str) -> bool:
+        if self.raylet is None or self.plasma is None:
+            return False
+        try:
+            reply = await self.raylet.request({"type": "pull_object",
+                                               "object_id": oid_hex}, timeout=300)
+            return bool(reply.get("ok")) or \
+                self.plasma.contains(ObjectID.from_hex(oid_hex))
+        except ConnectionLost:
+            return False
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        return self._run(self._wait_async(refs, num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        pending = {asyncio.ensure_future(
+            self._resolve_bytes(r.id, r.owner_address), loop=self.loop): r
+            for r in refs}
+        ready: List[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while pending and len(ready) < num_returns:
+            t = None if deadline is None else max(0, deadline - time.monotonic())
+            done, _ = await asyncio.wait(pending.keys(), timeout=t,
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                ready.append(pending.pop(fut))
+        for fut in pending:
+            fut.cancel()
+        ready_set = set(ready[:num_returns])
+        ordered_ready = [r for r in refs if r in ready_set]
+        not_ready = [r for r in refs if r not in ready_set]
+        return ordered_ready, not_ready
+
+    # ------------------------------------------------------------ functions
+
+    def export_function(self, func) -> str:
+        payload = cloudpickle.dumps(func)
+        fid = hashlib.sha1(payload).hexdigest()
+        if fid not in self._exported_functions:
+            self._run(self.gcs.request({
+                "type": "kv_put", "ns": "funcs", "key": fid.encode(),
+                "value": payload, "overwrite": False}))
+            self._exported_functions.add(fid)
+        return fid
+
+    async def load_function(self, fid: str):
+        fn = self._function_cache.get(fid)
+        if fn is None:
+            payload = await self.gcs.request({"type": "kv_get", "ns": "funcs",
+                                              "key": fid.encode()})
+            if payload is None:
+                raise RuntimeError(f"function {fid} not found in GCS")
+            fn = cloudpickle.loads(payload)
+            self._function_cache[fid] = fn
+        return fn
+
+    # ------------------------------------------------------------ args
+
+    def serialize_args(self, args: tuple, kwargs: dict):
+        """Each arg becomes ("v", bytes) inline, or ("ref", hex, owner)."""
+        out_args = [self._serialize_one(a) for a in args]
+        out_kwargs = {k: self._serialize_one(v) for k, v in kwargs.items()}
+        return out_args, out_kwargs
+
+    def _serialize_one(self, value):
+        if isinstance(value, ObjectRef):
+            entry = self.memory_store.get(value.hex())
+            if entry is not None and entry[0] == "val" and \
+                    len(entry[1]) <= INLINE_MAX:
+                return ("v", entry[1])
+            return ("ref", value.hex(), value.owner_address)
+        ser = self.ser.serialize(value)
+        if ser.total_size <= INLINE_MAX or self.plasma is None:
+            return ("v", ser.to_bytes())
+        oid = ObjectID.for_task_return(task_id_generator.next(), 0)
+        self._run_on_loop_sync(self._put_serialized(oid, ser))
+        # Keep a ref alive until the task consumes it by attaching it to the
+        # entry; the executor never refcounts these.
+        return ("ref", oid.hex(), self.address)
+
+    def _run_on_loop_sync(self, coro):
+        if threading.get_ident() == self._loop_thread.ident:
+            return asyncio.ensure_future(coro, loop=self.loop)
+        return self._run(coro)
+
+    async def resolve_args(self, args_entries, kwargs_entries):
+        async def one(entry):
+            kind = entry[0]
+            if kind == "v":
+                return self.ser.deserialize(memoryview(entry[1]))
+            _, oid_hex, owner = entry
+            data = await self._resolve_bytes(ObjectID.from_hex(oid_hex), owner)
+            return self._materialize(data)
+
+        args = list(await asyncio.gather(*[one(e) for e in args_entries]))
+        kwargs = {}
+        for k, e in kwargs_entries.items():
+            kwargs[k] = await one(e)
+        return args, kwargs
+
+    # ------------------------------------------------------------ tasks
+
+    def submit_task(self, func, args, kwargs, *, num_returns=1,
+                    resources=None, max_retries=DEFAULT_MAX_RETRIES,
+                    retry_exceptions=False, scheduling=None,
+                    name=None) -> List[ObjectRef]:
+        fid = self.export_function(func)
+        task_id = task_id_generator.next()
+        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        refs = [ObjectRef(oid, self.address) for oid in return_ids]
+        spec = {
+            "task_id": task_id.hex(),
+            "name": name or getattr(func, "__name__", "task"),
+            "fid": fid,
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "num_returns": num_returns,
+            "owner_address": self.address,
+        }
+        scheduling = scheduling or {}
+        resources = dict(resources or {"CPU": 1.0})
+        asyncio.run_coroutine_threadsafe(
+            self._submit_and_track(spec, resources, scheduling, max_retries,
+                                   retry_exceptions, return_ids),
+            self.loop)
+        for oid in return_ids:
+            self.owned.add(oid.hex())
+        return refs
+
+    async def _submit_and_track(self, spec, resources, scheduling, max_retries,
+                                retry_exceptions, return_ids):
+        attempts = max_retries + 1
+        last_err: Optional[BaseException] = None
+        for attempt in range(attempts):
+            try:
+                reply = await self._submit_once(spec, resources, scheduling)
+            except ConnectionLost as e:
+                last_err = rex.WorkerCrashedError(
+                    f"worker died executing task {spec['name']}")
+                continue
+            except Exception as e:  # scheduling failure etc.
+                last_err = e
+                break
+            if reply.get("ok"):
+                await self._store_task_returns(reply, return_ids)
+                return
+            # Application error.
+            if retry_exceptions and attempt < attempts - 1:
+                last_err = None
+                continue
+            for oid in return_ids:
+                self._store_local(oid.hex(), "err", reply["error"])
+            return
+        err = last_err or rex.WorkerCrashedError("task failed")
+        payload = cloudpickle.dumps((err, ""))
+        for oid in return_ids:
+            self._store_local(oid.hex(), "err", payload)
+
+    async def _submit_once(self, spec, resources, scheduling) -> dict:
+        raylet = self.raylet
+        lease_msg = {"type": "lease_worker", "resources": resources}
+        if scheduling.get("placement_group_id"):
+            lease_msg["pg_id"] = scheduling["placement_group_id"]
+            lease_msg["bundle_index"] = scheduling.get("bundle_index", 0) or 0
+            # Placement-group tasks must run on the bundle's node.
+            pg = await self.gcs.request({"type": "get_placement_group",
+                                         "pg_id": lease_msg["pg_id"]})
+            if pg is None:
+                raise rex.PlacementGroupUnavailableError(
+                    f"placement group {lease_msg['pg_id'][:16]} not found")
+            target_node = pg["allocations"].get(lease_msg["bundle_index"]) or \
+                pg["allocations"].get(str(lease_msg["bundle_index"]))
+            if target_node is not None:
+                nodes = await self.gcs.request({"type": "get_nodes"})
+                for n in nodes:
+                    if n["node_id"] == target_node:
+                        raylet = await self._get_worker_conn(n["address"])
+                        break
+        grant = await raylet.request(lease_msg, timeout=600)
+        for _ in range(8):
+            if "spillback" not in grant:
+                break
+            spill_conn = await self._get_worker_conn(grant["spillback"])
+            grant = await spill_conn.request(lease_msg, timeout=600)
+        if "spillback" in grant:
+            raise RuntimeError("lease spillback loop did not converge")
+        worker_conn = await self._get_worker_conn(grant["worker_address"])
+        lease_raylet = raylet
+        crashed = False
+        try:
+            return await worker_conn.request(
+                {"type": "push_task", "spec": spec}, timeout=None)
+        except ConnectionLost:
+            crashed = True
+            raise
+        finally:
+            try:
+                await lease_raylet.request({
+                    "type": "return_lease",
+                    "lease_id": grant["lease_id"],
+                    "worker_id": grant["worker_id"],
+                    "resources": grant["resources"],
+                    "pg_id": grant.get("pg_id"),
+                    "bundle_index": grant.get("bundle_index", 0),
+                    "worker_reusable": not crashed,
+                })
+            except Exception:
+                pass
+
+    async def _store_task_returns(self, reply: dict, return_ids):
+        for (oid_hex, kind, data), oid in zip(reply["returns"], return_ids):
+            if kind == "inline":
+                self._store_local(oid_hex, "val", data)
+            else:  # plasma, located on executor's node (directory has it)
+                self._store_local(oid_hex, "plasma", None)
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(self, cls, args, kwargs, *, resources=None,
+                     max_restarts=0, name=None, namespace="default",
+                     get_if_exists=False, detached=False, max_concurrency=1,
+                     scheduling=None) -> str:
+        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        creation_spec = cloudpickle.dumps({
+            "cls": cloudpickle.dumps(cls),
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "max_concurrency": max_concurrency,
+            "name": name,
+        })
+        actor_id = ActorID.from_random()
+        reply = self._run(self.gcs.request({
+            "type": "create_actor",
+            "actor_id": actor_id.hex(),
+            "name": name,
+            "namespace": namespace,
+            "creation_spec": creation_spec,
+            "resources": dict(resources or {"CPU": 1.0}),
+            "max_restarts": max_restarts,
+            "job_id": self.job_id,
+            "detached": detached,
+            "get_if_exists": get_if_exists,
+            "scheduling": scheduling or {},
+        }))
+        return reply["actor_id"]
+
+    def _actor(self, actor_id_hex: str) -> dict:
+        st = self.actor_state.get(actor_id_hex)
+        if st is None:
+            st = {"address": None, "conn": None, "seq": 0,
+                  "lock": asyncio.Lock(), "inflight": {}}
+            self.actor_state[actor_id_hex] = st
+        return st
+
+    def submit_actor_task(self, actor_id_hex: str, method: str, args, kwargs,
+                          *, num_returns=1) -> List[ObjectRef]:
+        task_id = task_id_generator.next()
+        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        refs = [ObjectRef(oid, self.address) for oid in return_ids]
+        for oid in return_ids:
+            self.owned.add(oid.hex())
+        call = {
+            "type": "actor_call",
+            "call_id": task_id.hex(),
+            "method": method,
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "num_returns": num_returns,
+            "owner_address": self.address,
+        }
+        asyncio.run_coroutine_threadsafe(
+            self._submit_actor_call(actor_id_hex, call, return_ids), self.loop)
+        return refs
+
+    async def _submit_actor_call(self, actor_id_hex, call, return_ids,
+                                 _retry: int = 0):
+        st = self._actor(actor_id_hex)
+        try:
+            conn = await self._actor_conn(actor_id_hex, st)
+            call = dict(call)
+            call["seq"] = st["seq"]
+            st["seq"] += 1
+            reply = await conn.request(call, timeout=None)
+            if reply.get("ok"):
+                await self._store_task_returns(reply, return_ids)
+            else:
+                for oid in return_ids:
+                    self._store_local(oid.hex(), "err", reply["error"])
+        except (ConnectionLost, asyncio.CancelledError):
+            st["conn"] = None
+            st["address"] = None
+            info = await self.gcs.request({"type": "wait_actor_state",
+                                           "actor_id": actor_id_hex})
+            if info is not None and info["state"] == "ALIVE" and _retry < 3:
+                await self._submit_actor_call(actor_id_hex, call, return_ids,
+                                              _retry + 1)
+                return
+            cause = (info or {}).get("death_cause", "actor connection lost")
+            payload = cloudpickle.dumps(
+                (rex.ActorDiedError(f"actor {actor_id_hex[:12]} died: {cause}"),
+                 ""))
+            for oid in return_ids:
+                self._store_local(oid.hex(), "err", payload)
+        except Exception as e:
+            payload = cloudpickle.dumps((e, traceback.format_exc()))
+            for oid in return_ids:
+                self._store_local(oid.hex(), "err", payload)
+
+    async def _actor_conn(self, actor_id_hex: str, st: dict) -> RpcConnection:
+        async with st["lock"]:
+            if st["conn"] is not None and not st["conn"].closed:
+                return st["conn"]
+            info = await self.gcs.request({"type": "wait_actor_state",
+                                           "actor_id": actor_id_hex})
+            if info is None:
+                raise rex.ActorDiedError(f"unknown actor {actor_id_hex[:12]}")
+            if info["state"] == "DEAD":
+                raise rex.ActorDiedError(
+                    f"actor {actor_id_hex[:12]} is dead: {info.get('death_cause')}")
+            st["address"] = info["address"]
+            st["conn"] = await connect(info["address"], self._handle_push,
+                                       name=f"cw->actor-{actor_id_hex[:8]}")
+            st["seq"] = 0
+            return st["conn"]
+
+    def kill_actor(self, actor_id_hex: str, no_restart: bool = True):
+        self._run(self.gcs.request({"type": "kill_actor",
+                                    "actor_id": actor_id_hex,
+                                    "no_restart": no_restart}))
+
+    def get_actor_info(self, actor_id_hex: str):
+        return self._run(self.gcs.request({"type": "get_actor_info",
+                                           "actor_id": actor_id_hex}))
+
+    def get_named_actor(self, name: str, namespace: str = "default"):
+        return self._run(self.gcs.request({"type": "get_named_actor",
+                                           "name": name,
+                                           "namespace": namespace}))
+
+    # ------------------------------------------------------------ misc
+
+    async def _get_worker_conn(self, addr: str) -> RpcConnection:
+        conn = self._worker_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await connect(addr, self._handle_push, name=f"cw->{addr}")
+            self._worker_conns[addr] = conn
+        return conn
+
+    def gcs_request(self, msg: dict, timeout: Optional[float] = None):
+        return self._run(self.gcs.request(msg), timeout)
+
+    def as_future(self, ref: ObjectRef):
+        return asyncio.run_coroutine_threadsafe(self.get_async(ref), self.loop)
+
+    # -- executor-side helpers (used by worker_main's TaskExecutor) --
+
+    def store_return_value(self, oid: ObjectID, ser) -> Tuple[str, str, Any]:
+        """Store one task return; returns the reply entry (hex, kind, data)."""
+        h = oid.hex()
+        if ser.total_size <= INLINE_MAX or self.plasma is None:
+            return (h, "inline", ser.to_bytes())
+        self.plasma.put_bytes(oid, ser.segments)
+        self._run_on_loop_sync(self.gcs.request({
+            "type": "object_location_add", "object_id": h,
+            "node_id": self.node_id_hex, "owner": ""}))
+        return (h, "plasma", None)

@@ -1,0 +1,134 @@
+"""Node daemon process: hosts the raylet (and, on the head node, the GCS).
+
+Design analog: reference ``python/ray/_private/node.py`` +
+``src/ray/raylet/main.cc`` / ``src/ray/gcs/gcs_server/gcs_server_main.cc``.
+The reference spawns gcs_server and raylet as separate processes; we co-host
+the GCS inside the head node's daemon process (they still talk over a real
+socket, preserving the rpc boundary) to keep process count sane on one host.
+
+Invoked as:  python -m ray_tpu._private.daemon_main --ready-file F [--head]
+             [--gcs-address HOST:PORT] [--resources JSON] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.raylet import Raylet
+
+logger = logging.getLogger(__name__)
+
+
+async def amain(args) -> None:
+    node_id = NodeID.from_random()
+    gcs = None
+    if args.head:
+        gcs = GcsServer()
+        gcs_port = await gcs.start(args.gcs_port)
+        gcs_address = f"127.0.0.1:{gcs_port}"
+    else:
+        gcs_address = args.gcs_address
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if "CPU" not in resources:
+        resources["CPU"] = float(os.cpu_count() or 1)
+    resources.setdefault("node", 1.0)
+    # TPU topology discovery (replaces reference's GPU autodetect,
+    # _private/resource_spec.py:287). Only the head claims real chips.
+    if args.head and not args.no_tpu_detect:
+        try:
+            chips = _detect_tpu_chips()
+            if chips:
+                resources.setdefault("TPU", float(chips))
+                resources.setdefault("tpu-host", 1.0)
+        except Exception:
+            pass
+
+    worker_env = json.loads(args.worker_env) if args.worker_env else {}
+    raylet = Raylet(
+        node_id=node_id,
+        gcs_address=gcs_address,
+        resources=resources,
+        store_capacity=args.store_capacity,
+        is_head=args.head,
+        worker_env=worker_env,
+    )
+    raylet_port = await raylet.start(0)
+
+    ready = {
+        "node_id": node_id.hex(),
+        "gcs_address": gcs_address,
+        "raylet_address": f"127.0.0.1:{raylet_port}",
+        "store_name": raylet.store_name,
+        "pid": os.getpid(),
+    }
+    tmp = args.ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ready, f)
+    os.replace(tmp, args.ready_file)
+
+    stop = asyncio.Event()
+
+    def _sig(*_):
+        stop.set()
+
+    asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, _sig)
+    asyncio.get_running_loop().add_signal_handler(signal.SIGINT, _sig)
+
+    # Exit if our parent (the driver or cluster launcher) disappears.
+    ppid = os.getppid()
+
+    async def watch_parent():
+        while True:
+            if os.getppid() != ppid:
+                stop.set()
+                return
+            await asyncio.sleep(1.0)
+
+    asyncio.get_running_loop().create_task(watch_parent())
+    await stop.wait()
+    await raylet.close()
+    if gcs is not None:
+        await gcs.close()
+
+
+def _detect_tpu_chips() -> int:
+    """TPU chip count without initializing a JAX backend in the daemon."""
+    env = os.environ.get("RT_NUM_TPU_CHIPS")
+    if env:
+        return int(env)
+    # Avoid importing jax here (slow, and would claim the chip); rely on
+    # device files like libtpu does.
+    import glob
+    accels = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+    return len(accels)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--gcs-address", default=None)
+    parser.add_argument("--gcs-port", type=int, default=0)
+    parser.add_argument("--resources", default=None)
+    parser.add_argument("--store-capacity", type=int, default=512 * 1024 * 1024)
+    parser.add_argument("--ready-file", required=True)
+    parser.add_argument("--worker-env", default=None)
+    parser.add_argument("--no-tpu-detect", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("RT_LOG_LEVEL", "WARNING"))
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

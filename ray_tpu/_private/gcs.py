@@ -1,0 +1,670 @@
+"""GCS: the cluster metadata authority and control plane.
+
+Design analog: reference ``src/ray/gcs/gcs_server/`` -- GcsServer, GcsNodeManager,
+GcsActorManager (+ GcsActorScheduler with restart-on-failure), GcsJobManager,
+GcsPlacementGroupManager/Scheduler, GcsResourceManager, GcsHealthCheckManager,
+GcsKvManager, pubsub Publisher.  One GCS per cluster, running on the head node
+daemon process; node daemons hold a persistent duplex connection to it, so the
+GCS can push work (actor creation, bundle reservation) down the same channel
+daemons use to heartbeat -- functionally the reference's gRPC service pairs.
+
+Like the reference (in_memory_store_client.h default), state is in-memory with
+an optional JSON snapshot for head restart (GCS fault tolerance analog of the
+Redis-backed gcs_table_storage).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu._private.protocol import RpcConnection, RpcServer
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_PERIOD_S = 0.5
+HEALTH_TIMEOUT_S = 5.0
+
+# Actor lifecycle states (reference: gcs_actor_manager.h / rpc::ActorTableData)
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str           # node daemon rpc address
+    store_name: str        # shm object store segment name
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    conn: Optional[RpcConnection] = None
+    is_head: bool = False
+
+    def public(self) -> dict:
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "store_name": self.store_name,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "alive": self.alive,
+            "is_head": self.is_head,
+        }
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: str
+    # Serialized actor creation spec (class ref, args, options) -- opaque to GCS.
+    creation_spec: bytes
+    resources: Dict[str, float]
+    max_restarts: int
+    num_restarts: int = 0
+    address: Optional[str] = None
+    node_id: Optional[NodeID] = None
+    owner_job: Optional[str] = None
+    detached: bool = False
+    death_cause: Optional[str] = None
+    scheduling: dict = field(default_factory=dict)
+    waiters: List[asyncio.Future] = field(default_factory=list)
+
+    def public(self) -> dict:
+        return {
+            "actor_id": self.actor_id.hex(),
+            "name": self.name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "resources": self.resources,
+            "death_cause": self.death_cause,
+        }
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"  # PENDING / CREATED / REMOVED
+    # bundle index -> node_id
+    allocations: Dict[int, NodeID] = field(default_factory=dict)
+    waiters: List[asyncio.Future] = field(default_factory=list)
+
+    def public(self) -> dict:
+        return {
+            "placement_group_id": self.pg_id.hex(),
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "state": self.state,
+            "allocations": {i: n.hex() for i, n in self.allocations.items()},
+        }
+
+
+class GcsServer:
+    """In-process asyncio GCS. Started by the head node daemon."""
+
+    def __init__(self, persist_path: Optional[str] = None):
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.jobs: Dict[str, dict] = {}
+        # object_id hex -> (owner address, set of node hexes with a copy)
+        self.object_dir: Dict[str, Tuple[str, Set[str]]] = {}
+        self.subscribers: Dict[str, List[RpcConnection]] = {}
+        self.server = RpcServer(self._make_handler)
+        self._persist_path = persist_path
+        self._health_task: Optional[asyncio.Task] = None
+        self._pending_actor_queue: List[ActorID] = []
+
+    async def start(self, port: int = 0) -> int:
+        port = await self.server.start(port)
+        self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
+        return port
+
+    async def close(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.close()
+
+    # ------------------------------------------------------------------ rpc
+
+    def _make_handler(self, conn: RpcConnection):
+        async def handle(msg: dict):
+            mtype = msg["type"]
+            fn = getattr(self, f"_h_{mtype}", None)
+            if fn is None:
+                raise ValueError(f"gcs: unknown message type {mtype}")
+            return await fn(conn, msg)
+
+        conn.on_close = self._on_conn_close
+        return handle
+
+    def _on_conn_close(self, conn: RpcConnection):
+        for subs in self.subscribers.values():
+            if conn in subs:
+                subs.remove(conn)
+        for node in self.nodes.values():
+            if node.conn is conn and node.alive:
+                logger.warning("node %s connection lost", node.node_id)
+                asyncio.get_event_loop().create_task(self._mark_node_dead(node))
+
+    async def _publish(self, channel: str, data: dict):
+        for conn in list(self.subscribers.get(channel, [])):
+            try:
+                await conn.notify({"type": "pub", "channel": channel, "data": data})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ kv
+
+    async def _h_kv_put(self, conn, msg):
+        ns = self.kv.setdefault(msg.get("ns", ""), {})
+        if not msg.get("overwrite", True) and msg["key"] in ns:
+            return False
+        ns[msg["key"]] = msg["value"]
+        return True
+
+    async def _h_kv_get(self, conn, msg):
+        return self.kv.get(msg.get("ns", ""), {}).get(msg["key"])
+
+    async def _h_kv_del(self, conn, msg):
+        return self.kv.get(msg.get("ns", ""), {}).pop(msg["key"], None) is not None
+
+    async def _h_kv_keys(self, conn, msg):
+        prefix = msg.get("prefix", b"")
+        return [k for k in self.kv.get(msg.get("ns", ""), {}) if k.startswith(prefix)]
+
+    async def _h_kv_exists(self, conn, msg):
+        return msg["key"] in self.kv.get(msg.get("ns", ""), {})
+
+    # ------------------------------------------------------------------ nodes
+
+    async def _h_register_node(self, conn, msg):
+        node = NodeInfo(
+            node_id=NodeID.from_hex(msg["node_id"]),
+            address=msg["address"],
+            store_name=msg["store_name"],
+            resources_total=dict(msg["resources"]),
+            resources_available=dict(msg["resources"]),
+            labels=msg.get("labels", {}),
+            conn=conn,
+            is_head=msg.get("is_head", False),
+        )
+        self.nodes[node.node_id] = node
+        await self._publish("nodes", {"event": "alive", "node": node.public()})
+        logger.info("node registered: %s at %s", node.node_id, node.address)
+        await self._try_schedule_pending()
+        return {"ok": True, "num_nodes": len(self.nodes)}
+
+    async def _h_heartbeat(self, conn, msg):
+        node = self.nodes.get(NodeID.from_hex(msg["node_id"]))
+        if node is None:
+            return {"ok": False}
+        node.last_heartbeat = time.monotonic()
+        if "resources_available" in msg:
+            node.resources_available = msg["resources_available"]
+        return {"ok": True}
+
+    async def _h_get_nodes(self, conn, msg):
+        return [n.public() for n in self.nodes.values()]
+
+    async def _h_drain_node(self, conn, msg):
+        node = self.nodes.get(NodeID.from_hex(msg["node_id"]))
+        if node is not None:
+            await self._mark_node_dead(node)
+        return {"ok": True}
+
+    async def _health_loop(self):
+        while True:
+            await asyncio.sleep(HEARTBEAT_PERIOD_S)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and not node.is_head and \
+                        now - node.last_heartbeat > HEALTH_TIMEOUT_S:
+                    logger.warning("node %s missed heartbeats; marking dead",
+                                   node.node_id)
+                    await self._mark_node_dead(node)
+
+    async def _mark_node_dead(self, node: NodeInfo):
+        if not node.alive:
+            return
+        node.alive = False
+        await self._publish("nodes", {"event": "dead", "node": node.public()})
+        # Restart or kill actors that lived on this node.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (ALIVE, PENDING, RESTARTING):
+                await self._on_actor_failure(actor, f"node {node.node_id.hex()} died")
+        # Drop object locations on that node.
+        for oid, (owner, locs) in list(self.object_dir.items()):
+            locs.discard(node.node_id.hex())
+
+    # ------------------------------------------------------------------ jobs
+
+    async def _h_register_job(self, conn, msg):
+        self.jobs[msg["job_id"]] = {
+            "job_id": msg["job_id"], "driver_address": msg.get("driver_address"),
+            "start_time": time.time(), "state": "RUNNING",
+        }
+        return {"ok": True}
+
+    async def _h_finish_job(self, conn, msg):
+        job = self.jobs.get(msg["job_id"])
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        return {"ok": True}
+
+    async def _h_get_jobs(self, conn, msg):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------ actors
+
+    async def _h_create_actor(self, conn, msg):
+        actor_id = ActorID.from_hex(msg["actor_id"])
+        name = msg.get("name")
+        namespace = msg.get("namespace", "default")
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != DEAD:
+                    if msg.get("get_if_exists"):
+                        return {"ok": True, "existing": True,
+                                "actor_id": existing.actor_id.hex()}
+                    raise ValueError(f"actor name '{name}' already taken")
+            self.named_actors[key] = actor_id
+        actor = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            namespace=namespace,
+            state=PENDING,
+            creation_spec=msg["creation_spec"],
+            resources=msg.get("resources", {"CPU": 1}),
+            max_restarts=msg.get("max_restarts", 0),
+            owner_job=msg.get("job_id"),
+            detached=msg.get("detached", False),
+            scheduling=msg.get("scheduling", {}),
+        )
+        self.actors[actor_id] = actor
+        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        return {"ok": True, "existing": False, "actor_id": actor_id.hex()}
+
+    def _pick_node_for(self, resources: Dict[str, float],
+                       scheduling: dict) -> Optional[NodeInfo]:
+        """Hybrid policy over the GCS resource view (reference:
+        gcs_actor_scheduler.h + hybrid_scheduling_policy.h): feasible nodes,
+        prefer the one with most available of the dominant resource."""
+        pg_hex = scheduling.get("placement_group_id")
+        if pg_hex:
+            pg = self.placement_groups.get(PlacementGroupID.from_hex(pg_hex))
+            if pg and pg.state == "CREATED":
+                idx = scheduling.get("bundle_index", 0)
+                if idx == -1:
+                    idx = 0
+                nid = pg.allocations.get(idx)
+                node = self.nodes.get(nid) if nid else None
+                if node and node.alive:
+                    return node
+            return None
+        node_hex = scheduling.get("node_id")
+        if node_hex:
+            node = self.nodes.get(NodeID.from_hex(node_hex))
+            if node and node.alive and self._fits(node, resources):
+                return node
+            if not scheduling.get("soft", False):
+                return None
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and self._fits(n, resources)]
+        if not candidates:
+            return None
+        if scheduling.get("strategy") == "SPREAD":
+            candidates.sort(key=lambda n: -sum(n.resources_available.values()))
+            return candidates[0]
+        dominant = max(resources, key=resources.get) if resources else "CPU"
+        candidates.sort(key=lambda n: -n.resources_available.get(dominant, 0.0))
+        return candidates[0]
+
+    @staticmethod
+    def _fits(node: NodeInfo, resources: Dict[str, float]) -> bool:
+        return all(node.resources_available.get(k, 0.0) >= v
+                   for k, v in resources.items() if v > 0)
+
+    async def _schedule_actor(self, actor: ActorInfo):
+        node = self._pick_node_for(actor.resources, actor.scheduling)
+        if node is None:
+            # No feasible node right now; queue until one registers.
+            if actor.actor_id not in self._pending_actor_queue:
+                self._pending_actor_queue.append(actor.actor_id)
+            return
+        actor.node_id = node.node_id
+        for k, v in actor.resources.items():
+            node.resources_available[k] = node.resources_available.get(k, 0.0) - v
+        try:
+            reply = await node.conn.request({
+                "type": "create_actor_worker",
+                "actor_id": actor.actor_id.hex(),
+                "creation_spec": actor.creation_spec,
+                "resources": actor.resources,
+                "pg_id": actor.scheduling.get("placement_group_id"),
+                "bundle_index": actor.scheduling.get("bundle_index", 0) or 0,
+            })
+            actor.address = reply["address"]
+            actor.state = ALIVE
+            self._wake_waiters(actor)
+            await self._publish("actors", {"event": "alive", "actor": actor.public()})
+        except Exception as e:
+            logger.warning("actor %s creation on node %s failed: %s",
+                           actor.actor_id, node.node_id, e)
+            for k, v in actor.resources.items():
+                node.resources_available[k] = node.resources_available.get(k, 0.0) + v
+            await self._on_actor_failure(actor, f"creation failed: {e}")
+
+    async def _try_schedule_pending(self):
+        queue, self._pending_actor_queue = self._pending_actor_queue, []
+        for actor_id in queue:
+            actor = self.actors.get(actor_id)
+            if actor is not None and actor.state in (PENDING, RESTARTING):
+                await self._schedule_actor(actor)
+
+    async def _on_actor_failure(self, actor: ActorInfo, reason: str):
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None and node.alive:
+            for k, v in actor.resources.items():
+                node.resources_available[k] = node.resources_available.get(k, 0.0) + v
+        actor.address = None
+        actor.node_id = None
+        if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            await self._publish("actors", {"event": "restarting",
+                                           "actor": actor.public()})
+            await self._schedule_actor(actor)
+        else:
+            actor.state = DEAD
+            actor.death_cause = reason
+            self._wake_waiters(actor)
+            await self._publish("actors", {"event": "dead", "actor": actor.public()})
+
+    def _wake_waiters(self, actor: ActorInfo):
+        for fut in actor.waiters:
+            if not fut.done():
+                fut.set_result(actor.public())
+        actor.waiters.clear()
+
+    async def _h_report_actor_death(self, conn, msg):
+        actor = self.actors.get(ActorID.from_hex(msg["actor_id"]))
+        if actor is None or actor.state == DEAD:
+            return {"ok": True}
+        if msg.get("intended", False):
+            actor.state = DEAD
+            actor.death_cause = "killed intentionally"
+            node = self.nodes.get(actor.node_id) if actor.node_id else None
+            if node is not None:
+                for k, v in actor.resources.items():
+                    node.resources_available[k] = \
+                        node.resources_available.get(k, 0.0) + v
+            self._wake_waiters(actor)
+            await self._publish("actors", {"event": "dead", "actor": actor.public()})
+        else:
+            await self._on_actor_failure(actor, msg.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _h_get_actor_info(self, conn, msg):
+        actor = self.actors.get(ActorID.from_hex(msg["actor_id"]))
+        return actor.public() if actor else None
+
+    async def _h_wait_actor_state(self, conn, msg):
+        """Long-poll until the actor reaches ALIVE or DEAD (addr resolution)."""
+        actor = self.actors.get(ActorID.from_hex(msg["actor_id"]))
+        if actor is None:
+            return None
+        if actor.state in (ALIVE, DEAD):
+            return actor.public()
+        fut = asyncio.get_running_loop().create_future()
+        actor.waiters.append(fut)
+        return await fut
+
+    async def _h_get_named_actor(self, conn, msg):
+        key = (msg.get("namespace", "default"), msg["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return None
+        actor = self.actors.get(actor_id)
+        return actor.public() if actor and actor.state != DEAD else None
+
+    async def _h_list_actors(self, conn, msg):
+        return [a.public() for a in self.actors.values()]
+
+    async def _h_list_named_actors(self, conn, msg):
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            a = self.actors.get(aid)
+            if a is not None and a.state != DEAD:
+                out.append({"namespace": ns, "name": name})
+        return out
+
+    async def _h_kill_actor(self, conn, msg):
+        actor = self.actors.get(ActorID.from_hex(msg["actor_id"]))
+        if actor is None:
+            return {"ok": False}
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None and node.conn is not None:
+            try:
+                await node.conn.request({"type": "kill_actor_worker",
+                                         "actor_id": actor.actor_id.hex(),
+                                         "no_restart": msg.get("no_restart", True)})
+            except Exception:
+                pass
+        if msg.get("no_restart", True):
+            actor.max_restarts = actor.num_restarts  # exhaust restarts
+        await self._h_report_actor_death(conn, {
+            "actor_id": actor.actor_id.hex(),
+            "intended": msg.get("no_restart", True),
+            "reason": "ray.kill",
+        })
+        return {"ok": True}
+
+    # ------------------------------------------------------------- placement
+
+    async def _h_create_placement_group(self, conn, msg):
+        pg = PlacementGroupInfo(
+            pg_id=PlacementGroupID.from_hex(msg["pg_id"]),
+            bundles=msg["bundles"],
+            strategy=msg.get("strategy", "PACK"),
+        )
+        self.placement_groups[pg.pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo):
+        """Bundle packing (reference: gcs_placement_group_scheduler.h +
+        bundle_scheduling_policy.h).  PACK fills one node first; SPREAD
+        round-robins; STRICT_PACK requires a single node; STRICT_SPREAD
+        requires distinct nodes."""
+        avail = {n.node_id: dict(n.resources_available)
+                 for n in self.nodes.values() if n.alive}
+        order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+        placement: Dict[int, NodeID] = {}
+
+        def fits(nid, bundle):
+            return all(avail[nid].get(k, 0.0) >= v for k, v in bundle.items())
+
+        def take(nid, bundle):
+            for k, v in bundle.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+        ok = True
+        if pg.strategy in ("PACK", "STRICT_PACK"):
+            for i, bundle in enumerate(pg.bundles):
+                chosen = None
+                for nid in order:
+                    if fits(nid, bundle) and (
+                        pg.strategy != "STRICT_PACK" or not placement
+                        or nid == next(iter(placement.values()))
+                    ):
+                        chosen = nid
+                        break
+                if chosen is None:
+                    ok = False
+                    break
+                placement[i] = chosen
+                take(chosen, bundle)
+        else:  # SPREAD / STRICT_SPREAD
+            used: Set[NodeID] = set()
+            for i, bundle in enumerate(pg.bundles):
+                ranked = sorted(order, key=lambda nid: (nid in used,
+                                                        -sum(avail[nid].values())))
+                chosen = None
+                for nid in ranked:
+                    if pg.strategy == "STRICT_SPREAD" and nid in used:
+                        continue
+                    if fits(nid, bundle):
+                        chosen = nid
+                        break
+                if chosen is None:
+                    ok = False
+                    break
+                placement[i] = chosen
+                used.add(chosen)
+                take(chosen, bundle)
+
+        if not ok:
+            # Leave PENDING; retried when nodes register.
+            return
+        # Reserve on each node daemon (single-phase commit with rollback;
+        # the reference does 2PC prepare/commit -- node_manager.proto:378).
+        reserved: List[Tuple[NodeInfo, int]] = []
+        try:
+            for i, nid in placement.items():
+                node = self.nodes[nid]
+                await node.conn.request({
+                    "type": "reserve_bundle",
+                    "pg_id": pg.pg_id.hex(),
+                    "bundle_index": i,
+                    "bundle": pg.bundles[i],
+                })
+                reserved.append((node, i))
+                for k, v in pg.bundles[i].items():
+                    node.resources_available[k] = \
+                        node.resources_available.get(k, 0.0) - v
+            pg.allocations = {i: nid for i, nid in placement.items()}
+            pg.state = "CREATED"
+            for fut in pg.waiters:
+                if not fut.done():
+                    fut.set_result(pg.public())
+            pg.waiters.clear()
+            await self._try_schedule_pending()
+        except Exception as e:
+            logger.warning("pg %s reservation failed: %s", pg.pg_id, e)
+            for node, i in reserved:
+                try:
+                    await node.conn.request({"type": "return_bundle",
+                                             "pg_id": pg.pg_id.hex(),
+                                             "bundle_index": i,
+                                             "bundle": pg.bundles[i]})
+                except Exception:
+                    pass
+
+    async def _h_pg_wait_ready(self, conn, msg):
+        pg = self.placement_groups.get(PlacementGroupID.from_hex(msg["pg_id"]))
+        if pg is None:
+            return None
+        if pg.state == "CREATED":
+            return pg.public()
+        fut = asyncio.get_running_loop().create_future()
+        pg.waiters.append(fut)
+        timeout = msg.get("timeout")
+        if timeout:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def _h_remove_placement_group(self, conn, msg):
+        pg = self.placement_groups.get(PlacementGroupID.from_hex(msg["pg_id"]))
+        if pg is None:
+            return {"ok": False}
+        for i, nid in pg.allocations.items():
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            try:
+                await node.conn.request({"type": "return_bundle",
+                                         "pg_id": pg.pg_id.hex(),
+                                         "bundle_index": i,
+                                         "bundle": pg.bundles[i]})
+            except Exception:
+                pass
+            for k, v in pg.bundles[i].items():
+                node.resources_available[k] = node.resources_available.get(k, 0.0) + v
+        pg.state = "REMOVED"
+        return {"ok": True}
+
+    async def _h_get_placement_group(self, conn, msg):
+        pg = self.placement_groups.get(PlacementGroupID.from_hex(msg["pg_id"]))
+        return pg.public() if pg else None
+
+    # ------------------------------------------------------------- objects
+
+    async def _h_object_location_add(self, conn, msg):
+        oid = msg["object_id"]
+        owner = msg.get("owner", "")
+        entry = self.object_dir.get(oid)
+        if entry is None:
+            self.object_dir[oid] = (owner, {msg["node_id"]})
+        else:
+            entry[1].add(msg["node_id"])
+        return {"ok": True}
+
+    async def _h_object_locations_get(self, conn, msg):
+        entry = self.object_dir.get(msg["object_id"])
+        if entry is None:
+            return None
+        return {"owner": entry[0], "nodes": list(entry[1])}
+
+    async def _h_object_location_remove(self, conn, msg):
+        entry = self.object_dir.get(msg["object_id"])
+        if entry is not None:
+            entry[1].discard(msg["node_id"])
+            if not entry[1]:
+                del self.object_dir[msg["object_id"]]
+        return {"ok": True}
+
+    # ------------------------------------------------------------- pubsub
+
+    async def _h_subscribe(self, conn, msg):
+        self.subscribers.setdefault(msg["channel"], []).append(conn)
+        return {"ok": True}
+
+    # ------------------------------------------------------------- misc
+
+    async def _h_cluster_resources(self, conn, msg):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+    async def _h_ping(self, conn, msg):
+        return {"ok": True, "time": time.time()}

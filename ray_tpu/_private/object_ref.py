@@ -1,0 +1,70 @@
+"""Distributed future handle.
+
+Design analog: reference ``python/ray/_raylet.pyx`` ObjectRef +
+``src/ray/core_worker/reference_count.h`` -- ownership-based refs.  The ref
+carries its owner's rpc address so any holder can resolve the value: owner's
+in-process memory store for small objects, the shared-memory store + GCS
+object directory for large ones.
+
+Refcounting: each live Python ObjectRef in a process counts one local
+reference; when a process's count for an id hits zero the CoreWorker is
+notified -- the owner frees owned objects eagerly, borrowers just forget.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID
+
+_refcount_sink = None  # set by CoreWorker at init
+
+
+def set_refcount_sink(sink):
+    global _refcount_sink
+    _refcount_sink = sink
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = ""):
+        self.id = object_id
+        self.owner_address = owner_address
+        if _refcount_sink is not None:
+            _refcount_sink.add_local_ref(self.id)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __del__(self):
+        if _refcount_sink is not None:
+            try:
+                _refcount_sink.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner_address))
+
+    # Allow `await ref` inside async actors / driver coroutines.
+    def __await__(self):
+        from ray_tpu._private.worker import global_worker
+        return global_worker.core_worker.get_async(self).__await__()
+
+    def future(self):
+        """concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private.worker import global_worker
+        return global_worker.core_worker.as_future(self)

@@ -1,0 +1,152 @@
+"""Python client for the native shared-memory object store.
+
+Design analog: reference ``src/ray/core_worker/store_provider/plasma_store_provider.h``
+(CoreWorkerPlasmaStoreProvider) + the plasma client protocol.  Unlike the
+reference there is no store server socket: every process attaches the segment
+and calls into the native library directly (see object_store.cc for rationale).
+
+Zero-copy reads: ``get_buffers`` returns memoryviews directly over the shm
+mapping; the deserializer builds numpy arrays on top of them without copying,
+matching plasma's mmap zero-copy read path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+from ray_tpu._native.build import ensure_built
+from ray_tpu._private.ids import ObjectID
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        lib.store_create.restype = ctypes.c_void_p
+        lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.store_attach.restype = ctypes.c_void_p
+        lib.store_attach.argtypes = [ctypes.c_char_p]
+        lib.store_detach.argtypes = [ctypes.c_void_p]
+        lib.store_create_object.restype = ctypes.c_int
+        lib.store_create_object.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.store_seal.restype = ctypes.c_int
+        lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_get.restype = ctypes.c_int
+        lib.store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.store_release.restype = ctypes.c_int
+        lib.store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_delete_object.restype = ctypes.c_int
+        lib.store_delete_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_contains.restype = ctypes.c_int
+        lib.store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_pointer.restype = ctypes.c_void_p
+        lib.store_pointer.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        for name in ("store_capacity", "store_bytes_used", "store_num_objects",
+                     "store_num_evictions"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class PlasmaClient:
+    """Per-process handle to the host-local shared object store."""
+
+    def __init__(self, name: str, capacity: Optional[int] = None, create: bool = False,
+                 num_slots: int = 1 << 16):
+        self._lib = _load()
+        self.name = name
+        if create:
+            self._h = self._lib.store_create(name.encode(), capacity, num_slots)
+        else:
+            self._h = self._lib.store_attach(name.encode())
+        if not self._h:
+            raise RuntimeError(f"failed to {'create' if create else 'attach'} store {name}")
+
+    # -- lifecycle --
+
+    def close(self):
+        if self._h:
+            self._lib.store_detach(self._h)
+            self._h = None
+
+    # -- object ops --
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        """Allocate an unsealed object; returns a writable view of its payload."""
+        off = ctypes.c_uint64()
+        rc = self._lib.store_create_object(self._h, object_id.binary(), size,
+                                           ctypes.byref(off))
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit in store {self.name}")
+        if rc == -3:
+            raise KeyError(f"object {object_id} already exists")
+        if rc != 0:
+            raise RuntimeError(f"store_create_object failed rc={rc}")
+        return self._view(off.value, size)
+
+    def seal(self, object_id: ObjectID):
+        rc = self._lib.store_seal(self._h, object_id.binary())
+        if rc != 0:
+            raise RuntimeError(f"seal failed rc={rc}")
+
+    def put_bytes(self, object_id: ObjectID, payloads: List[bytes]) -> int:
+        """Create+write+seal a multi-buffer object. Layout: see serialization.py."""
+        total = sum(len(p) for p in payloads)
+        buf = self.create(object_id, total)
+        pos = 0
+        for p in payloads:
+            buf[pos:pos + len(p)] = p
+            pos += len(p)
+        self.seal(object_id)
+        self.release(object_id)  # drop the creator ref; LRU-managed now
+        return total
+
+    def get(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Zero-copy read view of a sealed object; pins it until release()."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._h, object_id.binary(), ctypes.byref(off),
+                                 ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view(off.value, size.value)
+
+    def release(self, object_id: ObjectID):
+        self._lib.store_release(self._h, object_id.binary())
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return self._lib.store_delete_object(self._h, object_id.binary()) == 0
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.store_contains(self._h, object_id.binary()))
+
+    # -- introspection --
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity": self._lib.store_capacity(self._h),
+            "bytes_used": self._lib.store_bytes_used(self._h),
+            "num_objects": self._lib.store_num_objects(self._h),
+            "num_evictions": self._lib.store_num_evictions(self._h),
+        }
+
+    def _view(self, offset: int, size: int) -> memoryview:
+        ptr = self._lib.store_pointer(self._h, offset)
+        array_t = (ctypes.c_ubyte * size)
+        return memoryview(array_t.from_address(ptr)).cast("B")

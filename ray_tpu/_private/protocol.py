@@ -1,0 +1,226 @@
+"""Asyncio message transport used by every cross-process hop in the runtime.
+
+Design analog: reference ``src/ray/rpc/`` (GrpcServer/GrpcClient, client_call.h /
+server_call.h).  The reference wraps async gRPC; we use persistent length-prefixed
+pickle frames over TCP/unix sockets, which keeps the dependency surface tiny and
+is plenty for a control plane (bulk array data never rides these sockets -- it
+goes through the shared-memory object store, or chunked transfer frames).
+
+Every connection is symmetric: either side can issue requests (correlated by a
+request id) and receive one-way notifications.  This mirrors how the reference's
+workers both serve (PushTask) and call (RequestWorkerLease) RPCs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_HEADER = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+_REQUEST = 0
+_REPLY = 1
+_NOTIFY = 2
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+class RpcConnection:
+    """A duplex request/reply + notify channel over one stream.
+
+    handler(msg: dict) -> Awaitable[Any] serves incoming requests; the returned
+    value is pickled back as the reply.  Raising inside the handler sends the
+    exception to the peer, where it re-raises at the call site.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Optional[Callable[[dict], Awaitable[Any]]] = None,
+        name: str = "",
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._req_counter = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self.on_close: Optional[Callable[["RpcConnection"], None]] = None
+        self._serve_task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._serve_task = asyncio.get_running_loop().create_task(self._serve())
+        return self._serve_task
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def _send_frame(self, payload: bytes):
+        async with self._send_lock:
+            self.writer.write(_HEADER.pack(len(payload)))
+            self.writer.write(payload)
+            await self.writer.drain()
+
+    async def _read_frame(self) -> bytes:
+        head = await self.reader.readexactly(_HEADER.size)
+        (length,) = _HEADER.unpack(head)
+        if length > MAX_FRAME:
+            raise ConnectionLost(f"frame too large: {length}")
+        return await self.reader.readexactly(length)
+
+    async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        """Send a request and await the peer's reply."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        rid = next(self._req_counter)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send_frame(pickle.dumps((_REQUEST, rid, msg), protocol=5))
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(rid, None)
+
+    async def notify(self, msg: dict):
+        """Fire-and-forget one-way message."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        await self._send_frame(pickle.dumps((_NOTIFY, 0, msg), protocol=5))
+
+    async def _serve(self):
+        try:
+            while True:
+                frame = await self._read_frame()
+                kind, rid, msg = pickle.loads(frame)
+                if kind == _REQUEST:
+                    asyncio.get_running_loop().create_task(self._handle(rid, msg))
+                elif kind == _REPLY:
+                    fut = self._pending.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        ok, value = msg
+                        if ok:
+                            fut.set_result(value)
+                        else:
+                            fut.set_exception(value)
+                elif kind == _NOTIFY:
+                    asyncio.get_running_loop().create_task(self._handle(None, msg))
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            ConnectionLost,
+            OSError,
+        ):
+            pass
+        except Exception:
+            logger.exception("rpc serve loop error on %s", self.name)
+        finally:
+            await self._shutdown()
+
+    async def _handle(self, rid: Optional[int], msg: dict):
+        try:
+            result = await self.handler(msg)
+            ok = True
+        except Exception as e:  # noqa: BLE001 - forwarded to caller
+            if rid is None:
+                logger.exception("error handling notify %s", msg.get("type"))
+                return
+            result, ok = e, False
+        if rid is None:
+            return
+        try:
+            await self._send_frame(
+                pickle.dumps((_REPLY, rid, (ok, result)), protocol=5)
+            )
+        except Exception:
+            pass
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"peer {self.name} disconnected"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def close(self):
+        if self._serve_task is not None:
+            self._serve_task.cancel()
+        await self._shutdown()
+
+
+async def connect(
+    addr: str, handler: Callable[[dict], Awaitable[Any]], name: str = ""
+) -> RpcConnection:
+    """addr is "host:port" for TCP or "unix://path"."""
+    if addr.startswith("unix://"):
+        reader, writer = await asyncio.open_unix_connection(addr[len("unix://"):])
+    else:
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+    conn = RpcConnection(reader, writer, handler, name=name)
+    conn.start()
+    return conn
+
+
+class RpcServer:
+    """Accepts connections and wires each to a per-connection handler factory."""
+
+    def __init__(
+        self,
+        handler_factory: Callable[[RpcConnection], Callable[[dict], Awaitable[Any]]],
+        host: str = "127.0.0.1",
+    ):
+        self._factory = handler_factory
+        self._host = host
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: Optional[int] = None
+        self.connections: list[RpcConnection] = []
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_client, self._host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    async def _on_client(self, reader, writer):
+        conn = RpcConnection(reader, writer, None, name="server-peer")
+        conn.handler = self._factory(conn)
+        self.connections.append(conn)
+        conn.on_close = lambda c: self.connections.remove(c) if c in self.connections else None
+        conn.start()
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()

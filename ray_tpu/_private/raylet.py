@@ -1,0 +1,498 @@
+"""Node daemon: per-host worker pool, lease-based scheduling, object transfer.
+
+Design analog: reference ``src/ray/raylet/`` -- Raylet/NodeManager (leases
+workers to task submitters), WorkerPool (spawns & caches worker processes),
+LocalTaskManager (queues infeasible work), PlacementGroupResourceManager
+(bundle accounting), plus ``src/ray/object_manager/`` (PullManager/PushManager
+chunked node-to-node object transfer).
+
+One daemon process per (possibly simulated) node.  The head node's daemon also
+hosts the GcsServer in-process -- the reference runs gcs_server as a separate
+process on the head; co-hosting keeps process count down on a single machine
+while preserving the node/GCS rpc boundary (the daemon talks to the GCS it
+hosts through a real socket like every other node).
+
+Scheduling is lease-based exactly like the reference: a submitter asks its
+local raylet for a worker lease; the raylet either grants one (spawning a
+worker if the pool is empty), queues the request until resources free up, or
+replies with a spillback target chosen from the GCS cluster view, and the
+submitter retries there (hybrid_scheduling_policy.h's local-first behavior).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.plasma import PlasmaClient
+from ray_tpu._private.protocol import RpcConnection, RpcServer, connect
+
+logger = logging.getLogger(__name__)
+
+TRANSFER_CHUNK = 4 * 1024 * 1024  # 4MB frames for node-to-node object pushes
+IDLE_WORKER_CAP_PER_SHAPE = 8
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: subprocess.Popen
+    address: Optional[str] = None        # worker's rpc server addr
+    conn: Optional[RpcConnection] = None  # raylet<->worker channel
+    ready: asyncio.Future = None
+    actor_id: Optional[str] = None
+    lease_id: Optional[str] = None
+    busy: bool = False
+    actor_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
+
+
+@dataclass
+class LeaseRequest:
+    resources: Dict[str, float]
+    pg_id: Optional[str]
+    bundle_index: int
+    future: asyncio.Future = None
+
+
+class Raylet:
+    def __init__(
+        self,
+        node_id: NodeID,
+        gcs_address: str,
+        resources: Dict[str, float],
+        store_capacity: int = 512 * 1024 * 1024,
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = node_id
+        self.gcs_address = gcs_address
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.is_head = is_head
+        self.labels = labels or {}
+        self.worker_env = worker_env or {}
+        self.store_name = f"/rt_{node_id.hex()[:12]}"
+        self.plasma = PlasmaClient(self.store_name, capacity=store_capacity,
+                                   create=True)
+        self.server = RpcServer(self._make_handler)
+        self.gcs_conn: Optional[RpcConnection] = None
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self.pending_leases: List[LeaseRequest] = []
+        # pg bundle pools: (pg_id, bundle_index) -> available resources
+        self.bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._peer_conns: Dict[str, RpcConnection] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._shutdown = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, port: int = 0) -> int:
+        port = await self.server.start(port)
+        self.gcs_conn = await connect(self.gcs_address, self._handle_gcs_push,
+                                      name="raylet->gcs")
+        await self.gcs_conn.request({
+            "type": "register_node",
+            "node_id": self.node_id.hex(),
+            "address": self.server.address,
+            "store_name": self.store_name,
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "is_head": self.is_head,
+        })
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._heartbeat_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._reap_loop()))
+        return port
+
+    async def close(self):
+        self._shutdown = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in list(self.workers.values()):
+            try:
+                w.proc.wait(timeout=3)
+            except Exception:
+                w.proc.kill()
+        await self.server.close()
+        if self.gcs_conn:
+            await self.gcs_conn.close()
+        self.plasma.close()
+
+    async def _heartbeat_loop(self):
+        while not self._shutdown:
+            try:
+                await self.gcs_conn.request({
+                    "type": "heartbeat",
+                    "node_id": self.node_id.hex(),
+                    "resources_available": self.resources_available,
+                })
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    async def _reap_loop(self):
+        """Detect dead worker processes (reference: WorkerPool +
+        NodeManager::HandleUnexpectedWorkerFailure)."""
+        while not self._shutdown:
+            for w in list(self.workers.values()):
+                if w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+            await asyncio.sleep(0.2)
+
+    async def _on_worker_death(self, w: WorkerHandle):
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle_workers:
+            self.idle_workers.remove(w)
+        if w.ready is not None and not w.ready.done():
+            w.ready.set_exception(RuntimeError(
+                f"worker process exited with code {w.proc.returncode}"))
+        if w.lease_id is not None:
+            # The submitter will observe the broken connection and retry.
+            pass
+        if w.actor_id is not None:
+            res = getattr(w, "actor_resources", None)
+            if res is not None:
+                resources, pg_id, bidx = res
+                pool = self.bundles.get((pg_id, bidx),
+                                        self.resources_available) \
+                    if pg_id else self.resources_available
+                for k, v in resources.items():
+                    pool[k] = pool.get(k, 0.0) + v
+            try:
+                await self.gcs_conn.request({
+                    "type": "report_actor_death",
+                    "actor_id": w.actor_id,
+                    "reason": f"worker process exited with code {w.proc.returncode}",
+                })
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ gcs push
+
+    async def _handle_gcs_push(self, msg: dict):
+        mtype = msg["type"]
+        if mtype == "create_actor_worker":
+            return await self._create_actor_worker(msg)
+        if mtype == "kill_actor_worker":
+            return await self._kill_actor_worker(msg)
+        if mtype == "reserve_bundle":
+            self.bundles[(msg["pg_id"], msg["bundle_index"])] = dict(msg["bundle"])
+            for k, v in msg["bundle"].items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) - v
+            return {"ok": True}
+        if mtype == "return_bundle":
+            key = (msg["pg_id"], msg["bundle_index"])
+            if key in self.bundles:
+                del self.bundles[key]
+                # Restore what was carved out of node-level availability at
+                # reserve time (the original bundle shape, not what remains
+                # unleased inside it -- leases against the bundle return their
+                # resources to the bundle pool, which is now gone).
+                for k, v in msg.get("bundle", {}).items():
+                    self.resources_available[k] = \
+                        self.resources_available.get(k, 0.0) + v
+            return {"ok": True}
+        if mtype == "pub":
+            return None
+        raise ValueError(f"raylet: unknown gcs push {mtype}")
+
+    # ------------------------------------------------------------ workers
+
+    def _spawn_worker(self, actor_id: Optional[str] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env.update({
+            "RT_WORKER_ID": worker_id.hex(),
+            "RT_NODE_ID": self.node_id.hex(),
+            "RT_RAYLET_ADDRESS": self.server.address,
+            "RT_GCS_ADDRESS": self.gcs_address,
+            "RT_STORE_NAME": self.store_name,
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        w = WorkerHandle(worker_id=worker_id, proc=proc, actor_id=actor_id,
+                         ready=asyncio.get_running_loop().create_future())
+        self.workers[worker_id] = w
+        return w
+
+    async def _get_idle_worker(self) -> WorkerHandle:
+        while self.idle_workers:
+            w = self.idle_workers.pop()
+            if w.proc.poll() is None:
+                return w
+            await self._on_worker_death(w)
+        w = self._spawn_worker()
+        await asyncio.wait_for(w.ready, timeout=60)
+        return w
+
+    async def _create_actor_worker(self, msg: dict) -> dict:
+        # Account the actor's resources locally for its whole lifetime (the
+        # lease path is not involved for actors; reference raylet does the
+        # same when the GCS actor scheduler leases an actor worker).
+        resources = msg.get("resources", {})
+        pg_id = msg.get("pg_id")
+        pool = self.bundles.get((pg_id, msg.get("bundle_index", 0)),
+                                self.resources_available) \
+            if pg_id else self.resources_available
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) - v
+        try:
+            w = self._spawn_worker(actor_id=msg["actor_id"])
+            w.actor_resources = (resources, pg_id, msg.get("bundle_index", 0))
+            await asyncio.wait_for(w.ready, timeout=120)
+            reply = await w.conn.request({
+                "type": "create_actor",
+                "actor_id": msg["actor_id"],
+                "creation_spec": msg["creation_spec"],
+            })
+            if not reply.get("ok"):
+                raise RuntimeError(
+                    f"actor constructor failed: {reply.get('error')}")
+            return {"address": w.address, "worker_id": w.worker_id.hex()}
+        except Exception:
+            for k, v in resources.items():
+                pool[k] = pool.get(k, 0.0) + v
+            raise
+
+    async def _kill_actor_worker(self, msg: dict) -> dict:
+        for w in list(self.workers.values()):
+            if w.actor_id == msg["actor_id"]:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        return {"ok": True}
+
+    # ------------------------------------------------------------ handlers
+
+    def _make_handler(self, conn: RpcConnection):
+        async def handle(msg: dict):
+            mtype = msg["type"]
+            fn = getattr(self, f"_h_{mtype}", None)
+            if fn is None:
+                raise ValueError(f"raylet: unknown message type {mtype}")
+            return await fn(conn, msg)
+        return handle
+
+    async def _h_register_worker(self, conn, msg):
+        w = self.workers.get(WorkerID.from_hex(msg["worker_id"]))
+        if w is None:
+            raise ValueError("unknown worker registration")
+        w.address = msg["address"]
+        w.conn = conn
+        # The spawner (a pending _get_idle_worker / _create_actor_worker call)
+        # owns this worker and claims it through the ready future; it must NOT
+        # also enter the idle pool or it would be double-granted.
+        if not w.ready.done():
+            w.ready.set_result(True)
+        return {"ok": True, "node_id": self.node_id.hex()}
+
+    # -- leases (task scheduling) --
+
+    def _pool_for(self, req: LeaseRequest) -> Dict[str, float]:
+        if req.pg_id is not None:
+            return self.bundles.get((req.pg_id, req.bundle_index), {})
+        return self.resources_available
+
+    def _fits(self, req: LeaseRequest) -> bool:
+        pool = self._pool_for(req)
+        return all(pool.get(k, 0.0) >= v for k, v in req.resources.items() if v > 0)
+
+    def _feasible_ever(self, req: LeaseRequest) -> bool:
+        if req.pg_id is not None:
+            return (req.pg_id, req.bundle_index) in self.bundles or True
+        return all(self.resources_total.get(k, 0.0) >= v
+                   for k, v in req.resources.items() if v > 0)
+
+    async def _h_lease_worker(self, conn, msg):
+        req = LeaseRequest(
+            resources=msg.get("resources", {"CPU": 1.0}),
+            pg_id=msg.get("pg_id"),
+            bundle_index=msg.get("bundle_index", 0),
+            future=asyncio.get_running_loop().create_future(),
+        )
+        if not self._fits(req):
+            if not self._feasible_ever(req):
+                # Never feasible locally -> spillback to a node that fits.
+                nodes = await self.gcs_conn.request({"type": "get_nodes"})
+                for n in nodes:
+                    if n["alive"] and all(
+                        n["resources_total"].get(k, 0.0) >= v
+                        for k, v in req.resources.items() if v > 0
+                    ) and n["address"] != self.server.address:
+                        return {"spillback": n["address"]}
+                raise RuntimeError(
+                    f"no node in the cluster can ever satisfy {req.resources}")
+            self.pending_leases.append(req)
+            return await req.future
+        return await self._grant(req)
+
+    async def _grant(self, req: LeaseRequest) -> dict:
+        pool = self._pool_for(req)
+        for k, v in req.resources.items():
+            pool[k] = pool.get(k, 0.0) - v
+        try:
+            w = await self._get_idle_worker()
+        except Exception:
+            for k, v in req.resources.items():
+                pool[k] = pool.get(k, 0.0) + v
+            raise
+        lease_id = os.urandom(8).hex()
+        w.lease_id = lease_id
+        w.busy = True
+        return {"worker_address": w.address, "lease_id": lease_id,
+                "worker_id": w.worker_id.hex(),
+                "resources": req.resources, "pg_id": req.pg_id,
+                "bundle_index": req.bundle_index}
+
+    async def _h_return_lease(self, conn, msg):
+        pool = self.resources_available
+        if msg.get("pg_id") is not None:
+            pool = self.bundles.get((msg["pg_id"], msg.get("bundle_index", 0)),
+                                    self.resources_available)
+        for k, v in msg.get("resources", {}).items():
+            pool[k] = pool.get(k, 0.0) + v
+        wid = msg.get("worker_id")
+        if wid:
+            w = self.workers.get(WorkerID.from_hex(wid))
+            if w is not None and w.proc.poll() is None:
+                w.lease_id = None
+                w.busy = False
+                if msg.get("worker_reusable", True) and \
+                        len(self.idle_workers) < IDLE_WORKER_CAP_PER_SHAPE:
+                    self.idle_workers.append(w)
+                else:
+                    w.proc.terminate()
+                    self.workers.pop(w.worker_id, None)
+        await self._dispatch_leases()
+        return {"ok": True}
+
+    async def _dispatch_leases(self):
+        still_pending = []
+        for req in self.pending_leases:
+            if req.future.done():
+                continue
+            if self._fits(req):
+                try:
+                    grant = await self._grant(req)
+                    if not req.future.done():
+                        req.future.set_result(grant)
+                except Exception as e:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+            else:
+                still_pending.append(req)
+        self.pending_leases = still_pending
+
+    # -- object transfer (pull-based, reference object_manager/pull_manager) --
+
+    async def _h_fetch_object(self, conn, msg):
+        """Serve an object from local plasma as chunked frames (push side)."""
+        oid = ObjectID.from_hex(msg["object_id"])
+        view = self.plasma.get(oid)
+        if view is None:
+            return {"found": False}
+        try:
+            total = len(view)
+            offset = msg.get("offset", 0)
+            end = min(offset + TRANSFER_CHUNK, total)
+            return {"found": True, "total": total, "offset": offset,
+                    "data": bytes(view[offset:end])}
+        finally:
+            view.release()
+            self.plasma.release(oid)
+
+    async def _h_pull_object(self, conn, msg):
+        """Pull an object from a remote node into local plasma."""
+        oid = ObjectID.from_hex(msg["object_id"])
+        if self.plasma.contains(oid):
+            return {"ok": True}
+        loc = await self.gcs_conn.request({"type": "object_locations_get",
+                                           "object_id": msg["object_id"]})
+        if loc is None or not loc["nodes"]:
+            return {"ok": False, "error": "no locations"}
+        nodes = await self.gcs_conn.request({"type": "get_nodes"})
+        addr = None
+        for n in nodes:
+            if n["node_id"] in loc["nodes"] and n["alive"] and \
+                    n["node_id"] != self.node_id.hex():
+                addr = n["address"]
+                break
+        if addr is None:
+            return {"ok": False, "error": "no live remote location"}
+        peer = await self._peer(addr)
+        first = await peer.request({"type": "fetch_object",
+                                    "object_id": msg["object_id"], "offset": 0})
+        if not first.get("found"):
+            return {"ok": False, "error": "object missing at remote"}
+        total = first["total"]
+        if self.plasma.contains(oid):
+            return {"ok": True}
+        buf = self.plasma.create(oid, total)
+        try:
+            data = first["data"]
+            buf[0:len(data)] = data
+            pos = len(data)
+            while pos < total:
+                chunk = await peer.request({"type": "fetch_object",
+                                            "object_id": msg["object_id"],
+                                            "offset": pos})
+                if not chunk.get("found"):
+                    raise RuntimeError("object evicted at remote mid-transfer")
+                d = chunk["data"]
+                buf[pos:pos + len(d)] = d
+                pos += len(d)
+        except Exception as e:
+            self.plasma.release(oid)
+            self.plasma.delete(oid)
+            return {"ok": False, "error": str(e)}
+        self.plasma.seal(oid)
+        self.plasma.release(oid)
+        await self.gcs_conn.request({"type": "object_location_add",
+                                     "object_id": msg["object_id"],
+                                     "node_id": self.node_id.hex()})
+        return {"ok": True}
+
+    async def _peer(self, addr: str) -> RpcConnection:
+        conn = self._peer_conns.get(addr)
+        if conn is None or conn.closed:
+            async def _noop(msg):
+                return None
+            conn = await connect(addr, _noop, name=f"raylet-peer-{addr}")
+            self._peer_conns[addr] = conn
+        return conn
+
+    async def _h_stats(self, conn, msg):
+        return {
+            "node_id": self.node_id.hex(),
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "pending_leases": len(self.pending_leases),
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "plasma": self.plasma.stats(),
+        }
+
+    async def _h_ping(self, conn, msg):
+        return {"ok": True}

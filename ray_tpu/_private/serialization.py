@@ -1,0 +1,146 @@
+"""Serialization of task args/returns and put objects.
+
+Design analog: reference ``python/ray/_private/serialization.py``
+(SerializationContext) + vendored cloudpickle.  Same core trick: pickle
+protocol 5 with out-of-band buffers, so numpy/jax array payloads are split
+from the pickle stream and written contiguously into shared memory; on read,
+arrays are rebuilt as views over the shm mapping (zero-copy, like the
+reference's plasma-backed numpy views).
+
+On-disk/shm layout of a serialized object:
+
+    [u32 magic][u32 nbufs][u64 pickle_len][u64 buf_len * nbufs]
+    [pickle bytes][pad to 64][buf 0][pad to 64][buf 1]...
+
+JAX arrays are reduced to numpy on serialize and rebuilt with ``jnp.asarray``
+on deserialize -- device transfer happens lazily at first use inside jit, which
+is the TPU-idiomatic behavior (host numpy is the interchange format; device
+placement is the consumer's mesh decision, not the producer's).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+_MAGIC = 0x52545031  # "RTP1"
+_ALIGN = 64
+_HEAD = struct.Struct("<II")
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """A serialized value as a list of payload segments (for vectored writes)."""
+
+    __slots__ = ("segments", "total_size", "inband_size")
+
+    def __init__(self, segments: List[bytes], inband_size: int):
+        self.segments = segments
+        self.total_size = sum(len(s) for s in segments)
+        self.inband_size = inband_size
+
+    def to_bytes(self) -> bytes:
+        return b"".join(bytes(s) for s in self.segments)
+
+
+class SerializationContext:
+    """Pluggable reducers + pack/unpack of the shm layout."""
+
+    def __init__(self):
+        self._custom_reducers = {}
+        self._jax_registered = False
+
+    def register_reducer(self, cls, reducer: Callable):
+        self._custom_reducers[cls] = reducer
+
+    def _maybe_register_jax(self):
+        # Lazy: never import jax ourselves (workers that don't touch jax must
+        # not pay the import, and must not initialize a TPU backend).
+        import sys
+        if not self._jax_registered and "jax" in sys.modules:
+            self._jax_registered = True
+            _register_jax_reducers()
+
+    # -- serialize --
+
+    def serialize(self, value: Any) -> SerializedObject:
+        self._maybe_register_jax()
+        buffers: List[pickle.PickleBuffer] = []
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
+        raws = [b.raw() for b in buffers]
+        header = _HEAD.pack(_MAGIC, len(raws))
+        lens = struct.pack(f"<{len(raws) + 1}Q", len(payload), *[r.nbytes for r in raws])
+        segments: List[bytes] = [header, lens, payload]
+        pos = len(header) + len(lens) + len(payload)
+        for r in raws:
+            padding = _pad(pos) - pos
+            if padding:
+                segments.append(b"\x00" * padding)
+                pos += padding
+            segments.append(r)
+            pos += r.nbytes
+        return SerializedObject(segments, inband_size=len(payload))
+
+    # -- deserialize --
+
+    def deserialize(self, data: memoryview) -> Any:
+        data = memoryview(data)
+        magic, nbufs = _HEAD.unpack_from(data, 0)
+        if magic != _MAGIC:
+            raise ValueError("corrupt serialized object (bad magic)")
+        off = _HEAD.size
+        lens = struct.unpack_from(f"<{nbufs + 1}Q", data, off)
+        off += 8 * (nbufs + 1)
+        pickle_len, buf_lens = lens[0], lens[1:]
+        payload = data[off:off + pickle_len]
+        pos = off + pickle_len
+        bufs = []
+        for blen in buf_lens:
+            pos = _pad(pos)
+            bufs.append(data[pos:pos + blen])
+            pos += blen
+        return pickle.loads(payload, buffers=bufs)
+
+    def deserialize_bytes(self, data: bytes) -> Any:
+        return self.deserialize(memoryview(data))
+
+
+_default_context: Optional[SerializationContext] = None
+
+
+def get_context() -> SerializationContext:
+    global _default_context
+    if _default_context is None:
+        _default_context = SerializationContext()
+    return _default_context
+
+
+def _register_jax_reducers():
+    """Make jax.Array pickle as host numpy, rebuilt as jnp on load."""
+    try:
+        import jax
+        import numpy as np
+
+        def _rebuild(np_value):
+            import jax.numpy as jnp
+            return jnp.asarray(np_value)
+
+        def _reduce_jax_array(arr):
+            return _rebuild, (np.asarray(arr),)
+
+        import copyreg
+        copyreg.pickle(jax.Array, _reduce_jax_array)
+        # Concrete array classes are registered dynamically; cloudpickle
+        # dispatches on exact type, so register the common concrete type too.
+        concrete = type(jax.numpy.zeros((), dtype=jax.numpy.float32))
+        copyreg.pickle(concrete, _reduce_jax_array)
+    except Exception:  # jax not importable in some tool contexts
+        pass

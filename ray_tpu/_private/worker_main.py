@@ -1,0 +1,246 @@
+"""Worker process entry point + task/actor executor.
+
+Design analog: reference ``python/ray/_private/workers/default_worker.py`` +
+the Cython execution loop ``_raylet.pyx execute_task:700`` and the
+execution-side scheduling queues in ``src/ray/core_worker/transport/``
+(NormalSchedulingQueue, ActorSchedulingQueue with sequence numbers,
+ConcurrencyGroupManager for async actors).
+
+Execution model:
+  * normal tasks and sync actor methods run serially on the dedicated
+    execution thread (actor serial semantics);
+  * async (coroutine) actor methods run on the IO loop, bounded by a
+    max_concurrency semaphore -- the analog of the reference's fiber-based
+    async actors (fiber.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import sys
+import traceback
+
+import cloudpickle
+
+from ray_tpu._private.core_worker import CoreWorker, _serialize_exception
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.protocol import connect
+
+logger = logging.getLogger(__name__)
+
+
+class TaskExecutor:
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.actor_instance = None
+        self.actor_id = None
+        self.max_concurrency = 1
+        self._sem: asyncio.Semaphore = None
+        self._exit_requested = False
+        self._order: dict = {}
+
+    async def handle(self, conn, msg: dict):
+        mtype = msg["type"]
+        if mtype == "push_task":
+            return await self._execute_task(msg["spec"])
+        if mtype == "create_actor":
+            return await self._create_actor(msg)
+        if mtype == "actor_call":
+            return await self._actor_call(conn, msg)
+        if mtype == "ping":
+            return {"ok": True}
+        if mtype == "exit":
+            asyncio.get_running_loop().call_later(0.1, sys.exit, 0)
+            return {"ok": True}
+        raise ValueError(f"executor: unknown message {mtype}")
+
+    # -- normal tasks --
+
+    async def _execute_task(self, spec: dict) -> dict:
+        try:
+            fn = await self.core.load_function(spec["fid"])
+            args, kwargs = await self.core.resolve_args(spec["args"],
+                                                        spec["kwargs"])
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                self.core.exec_pool, lambda: fn(*args, **kwargs))
+            return self._pack_returns(spec, result)
+        except SystemExit as e:
+            asyncio.get_running_loop().call_later(0.2, os._exit,
+                                                  e.code or 0)
+            return {"ok": False, "error": _serialize_exception(
+                RuntimeError("worker exited via SystemExit"))}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": _serialize_exception(e)}
+
+    def _pack_returns(self, spec: dict, result) -> dict:
+        num_returns = spec["num_returns"]
+        if num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(results)} values")
+        from ray_tpu._private.ids import TaskID
+        task_id = TaskID(bytes.fromhex(spec.get("call_id") or spec["task_id"]))
+        returns = []
+        for i, value in enumerate(results):
+            oid = ObjectID.for_task_return(task_id, i)
+            ser = self.core.ser.serialize(value)
+            returns.append(self.core.store_return_value(oid, ser))
+        return {"ok": True, "returns": returns}
+
+    # -- actors --
+
+    async def _create_actor(self, msg: dict) -> dict:
+        try:
+            spec = cloudpickle.loads(msg["creation_spec"])
+            cls = cloudpickle.loads(spec["cls"])
+            args, kwargs = await self.core.resolve_args(spec["args"],
+                                                       spec["kwargs"])
+            self.max_concurrency = spec.get("max_concurrency", 1)
+            self._sem = asyncio.Semaphore(self.max_concurrency)
+            self.actor_id = msg["actor_id"]
+            loop = asyncio.get_running_loop()
+            self.actor_instance = await loop.run_in_executor(
+                self.core.exec_pool, lambda: cls(*args, **kwargs))
+            title = getattr(cls, "__name__", "Actor")
+            _set_proc_title(f"ray_tpu::actor::{title}")
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001
+            logger.exception("actor constructor failed")
+            return {"ok": False, "error": f"{type(e).__name__}: {e}\n"
+                    f"{traceback.format_exc()}"}
+
+    async def _actor_call(self, conn, msg: dict) -> dict:
+        # Per-caller in-order execution start (reference:
+        # ActorSchedulingQueue sequence numbers). One handle = one connection;
+        # seq restarts at 0 on reconnect after actor restart.
+        key = id(conn)
+        order = self._order.get(key)
+        if order is None:
+            order = self._order[key] = {"next": 0, "cond": asyncio.Condition()}
+        seq = msg.get("seq", 0)
+        if self._exit_requested:
+            from ray_tpu.exceptions import ActorDiedError
+            return {"ok": False, "error": _serialize_exception(
+                ActorDiedError("actor exited via exit_actor()"))}
+        try:
+            async with order["cond"]:
+                await order["cond"].wait_for(lambda: order["next"] >= seq)
+            method = getattr(self.actor_instance, msg["method"])
+            args, kwargs = await self.core.resolve_args(msg["args"],
+                                                        msg["kwargs"])
+            if inspect.iscoroutinefunction(method):
+                async with self._sem:
+                    await self._advance(order, seq)
+                    result = await method(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                fut = loop.run_in_executor(
+                    self.core.exec_pool, lambda: method(*args, **kwargs))
+                await self._advance(order, seq)
+                result = await fut
+            spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],
+                    "call_id": msg["call_id"]}
+            return self._pack_returns(spec, result)
+        except SystemExit:
+            # exit_actor(): report intended death, reply an error to this call
+            # (matching the reference: the exiting call resolves to an
+            # ActorError), and hard-exit shortly after the reply flushes.
+            # Never re-raise -- SystemExit escaping an asyncio task would tear
+            # down the IO loop before the exit is scheduled.
+            await self._report_intended_exit()
+            from ray_tpu.exceptions import ActorDiedError
+            return {"ok": False, "error": _serialize_exception(
+                ActorDiedError("actor exited via exit_actor()"))}
+        except Exception as e:  # noqa: BLE001
+            await self._advance(order, seq)
+            return {"ok": False, "error": _serialize_exception(e)}
+
+    @staticmethod
+    async def _advance(order: dict, seq: int):
+        async with order["cond"]:
+            if order["next"] <= seq:
+                order["next"] = seq + 1
+            order["cond"].notify_all()
+
+    async def _report_intended_exit(self):
+        self._exit_requested = True
+        if self.actor_id:
+            try:
+                await self.core.gcs.request({"type": "report_actor_death",
+                                             "actor_id": self.actor_id,
+                                             "intended": True})
+            except Exception:
+                pass
+        asyncio.get_running_loop().call_later(0.2, os._exit, 0)
+
+
+def _set_proc_title(title: str):
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None)
+        buf = ctypes.create_string_buffer(title.encode()[:15])
+        libc.prctl(15, buf, 0, 0, 0)  # PR_SET_NAME
+    except Exception:
+        pass
+
+
+def main():
+    logging.basicConfig(level=os.environ.get("RT_LOG_LEVEL", "WARNING"))
+    worker_id = os.environ["RT_WORKER_ID"]
+    node_id = os.environ["RT_NODE_ID"]
+    raylet_address = os.environ["RT_RAYLET_ADDRESS"]
+    gcs_address = os.environ["RT_GCS_ADDRESS"]
+    store_name = os.environ["RT_STORE_NAME"]
+    _set_proc_title("ray_tpu::worker")
+
+    core = CoreWorker(
+        gcs_address=gcs_address,
+        raylet_address=raylet_address,
+        store_name=store_name,
+        node_id_hex=node_id,
+        job_id="",
+        is_worker=True,
+    )
+    executor = TaskExecutor(core)
+    core.task_executor = executor
+
+    # Make this process's global_worker usable (nested task submission).
+    from ray_tpu._private import worker as worker_mod
+    worker_mod.global_worker.attach_core(core, mode="worker")
+
+    async def register():
+        conn = await connect(raylet_address,
+                             lambda m: executor.handle(None, m),
+                             name="worker->raylet")
+        await conn.request({"type": "register_worker",
+                            "worker_id": worker_id,
+                            "address": core.address})
+        return conn
+
+    raylet_conn = asyncio.run_coroutine_threadsafe(register(), core.loop).result()
+
+    # Exit when the raylet goes away (our parent).
+    import threading
+    import time
+
+    def watch():
+        ppid = os.getppid()
+        while True:
+            if os.getppid() != ppid or raylet_conn.closed:
+                os._exit(0)
+            time.sleep(1.0)
+
+    threading.Thread(target=watch, daemon=True).start()
+    threading.Event().wait()  # serve forever on the loop thread
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Actor classes and handles.
+
+Design analog: reference ``python/ray/actor.py`` (ActorClass._remote:659,
+ActorHandle, ActorMethod) with max_restarts/max_task_retries options
+(actor.py:326-345).  Method calls go through the CoreWorker's direct actor
+transport (per-handle ordering, restart-aware resubmission).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.worker import get_core
+from ray_tpu.remote_function import _build_resources, _build_scheduling
+
+_ACTOR_DEFAULTS = dict(
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    name=None,
+    namespace=None,
+    get_if_exists=False,
+    lifetime=None,          # None | "detached"
+    max_concurrency=1,
+    scheduling_strategy=None,
+    num_returns=1,
+)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        core = get_core()
+        refs = core.submit_actor_task(
+            self._handle._actor_id_hex, self._method_name, args, kwargs,
+            num_returns=self._num_returns)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method '{self._method_name}' cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id_hex: str, class_name: str = "Actor"):
+        self._actor_id_hex = actor_id_hex
+        self._class_name = class_name
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id_hex[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id_hex, self._class_name))
+
+    @property
+    def _actor_id(self) -> str:
+        return self._actor_id_hex
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = {**_ACTOR_DEFAULTS, **(options or {})}
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()")
+
+    def options(self, **kwargs) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **kwargs})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = get_core()
+        opts = self._options
+        from ray_tpu._private.worker import global_worker
+        namespace = opts["namespace"] or global_worker.namespace
+        actor_id_hex = core.create_actor(
+            self._cls, args, kwargs,
+            resources=_build_resources(opts),
+            max_restarts=opts["max_restarts"],
+            name=opts["name"],
+            namespace=namespace,
+            get_if_exists=opts["get_if_exists"],
+            detached=opts["lifetime"] == "detached",
+            max_concurrency=opts["max_concurrency"],
+            scheduling=_build_scheduling(opts),
+        )
+        return ActorHandle(actor_id_hex, self._cls.__name__)
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods
+    (reference: ray.actor.exit_actor)."""
+    raise SystemExit(0)

@@ -1,0 +1,87 @@
+"""@ray_tpu.remote functions.
+
+Design analog: reference ``python/ray/remote_function.py`` (RemoteFunction,
+``_remote:241``) and option plumbing (``_private/ray_option_utils.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.worker import get_core
+
+_DEFAULTS = dict(
+    num_returns=1,
+    num_cpus=1.0,
+    num_tpus=0.0,
+    resources=None,
+    max_retries=3,
+    retry_exceptions=False,
+    scheduling_strategy=None,
+    name=None,
+)
+
+
+def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    return res
+
+
+def _build_scheduling(opts: Dict[str, Any]) -> Dict[str, Any]:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None:
+        return {}
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return {
+            "placement_group_id": strategy.placement_group.id.hex(),
+            "bundle_index": strategy.placement_group_bundle_index,
+        }
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"node_id": strategy.node_id, "soft": strategy.soft}
+    if isinstance(strategy, str):
+        return {"strategy": strategy}
+    return {}
+
+
+class RemoteFunction:
+    def __init__(self, func, options: Optional[Dict[str, Any]] = None):
+        self._function = func
+        self._options = {**_DEFAULTS, **(options or {})}
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function '{self._function.__name__}' cannot be called "
+            f"directly; use {self._function.__name__}.remote()")
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        return RemoteFunction(self._function, {**self._options, **kwargs})
+
+    def remote(self, *args, **kwargs):
+        core = get_core()
+        opts = self._options
+        refs = core.submit_task(
+            self._function, args, kwargs,
+            num_returns=opts["num_returns"],
+            resources=_build_resources(opts),
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            scheduling=_build_scheduling(opts),
+            name=opts["name"] or self._function.__name__,
+        )
+        if opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def func(self):
+        return self._function

@@ -1,0 +1,88 @@
+"""Multi-node tests using the simulated cluster
+(reference analog: tests using ray.cluster_utils.Cluster + test_failure*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"worker_node": 1.0})
+    ray_tpu.init(address=c.address)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_two_nodes_registered(cluster):
+    alive = [n for n in ray_tpu.nodes() if n["alive"]]
+    assert len(alive) == 2
+    assert ray_tpu.cluster_resources().get("CPU") == 4.0
+
+
+def test_task_spillback_to_remote_node(cluster):
+    """A task needing a resource only on the worker node spills over."""
+
+    @ray_tpu.remote(resources={"worker_node": 1.0}, num_cpus=1)
+    def where():
+        import os
+        return os.environ["RT_NODE_ID"]
+
+    node_id = ray_tpu.get(where.remote())
+    worker_node = cluster.worker_nodes[0]
+    assert node_id == worker_node.node_id
+
+
+def test_cross_node_object_transfer(cluster):
+    """Large object produced on one node, consumed on another -> pull path."""
+
+    @ray_tpu.remote(resources={"worker_node": 1.0})
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # 4MB, plasma on worker node
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    expect = float(np.arange(500_000, dtype=np.float64).sum())
+    # Driver-side get pulls to head node plasma.
+    arr = ray_tpu.get(ref)
+    assert float(arr.sum()) == expect
+    # Task on head node also resolves it.
+    assert ray_tpu.get(consume.remote(ref)) == expect
+
+
+def test_actor_node_death_restart(cluster):
+    """Actor restarts on another node when its node dies
+    (reference analog: test_actor_failures / gcs actor reconstruction)."""
+    n2 = cluster.add_node(num_cpus=2, resources={"doomed": 1.0})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_restarts=1, resources={"doomed": 0.001})
+    class A:
+        def node(self):
+            import os
+            return os.environ["RT_NODE_ID"]
+
+    a = A.remote()  # lands on the doomed node via its custom resource
+    assert ray_tpu.get(a.node.remote()) == n2.node_id
+
+    cluster.remove_node(n2)  # hard kill; "doomed" now exists nowhere
+    n3 = cluster.add_node(num_cpus=2, resources={"doomed": 1.0})
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            nid = ray_tpu.get(a.node.remote(), timeout=10)
+            break
+        except Exception:
+            assert time.monotonic() < deadline, "actor never recovered"
+            time.sleep(0.5)
+    assert nid == n3.node_id

@@ -1,0 +1,134 @@
+"""Core task/object API tests (reference analog: python/ray/tests/test_basic*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+@ray_tpu.remote
+def f_add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def f_identity(x):
+    return x
+
+
+@ray_tpu.remote
+def f_fail():
+    raise ValueError("boom")
+
+
+def test_simple_task(ray_start):
+    assert ray_tpu.get(f_add.remote(1, 2)) == 3
+
+
+def test_kwargs_and_options(ray_start):
+    @ray_tpu.remote
+    def g(a, b=10):
+        return a * b
+
+    assert ray_tpu.get(g.remote(3)) == 30
+    assert ray_tpu.get(g.options(num_cpus=0.5).remote(3, b=2)) == 6
+
+
+def test_multiple_returns(ray_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_put_get_roundtrip(ray_start):
+    for value in [42, "hello", {"k": [1, 2]}, None, (1, "x")]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_large_object_plasma(ray_start):
+    arr = np.random.rand(500_000).astype(np.float32)  # ~2MB -> plasma
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_task_arg_by_ref(ray_start):
+    big = np.arange(300_000, dtype=np.int64)  # > inline threshold
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(f_identity.remote(ref))
+    np.testing.assert_array_equal(out, big)
+
+
+def test_task_dependency_chain(ray_start):
+    r1 = f_add.remote(1, 1)
+    r2 = f_add.remote(r1, 1)
+    r3 = f_add.remote(r2, r1)
+    assert ray_tpu.get(r3) == 5
+
+
+def test_task_error_propagates(ray_start):
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(f_fail.remote())
+    assert "boom" in str(exc_info.value)
+    assert isinstance(exc_info.value.cause, ValueError)
+
+
+def test_get_timeout(ray_start):
+    @ray_tpu.remote
+    def slow():
+        import time
+        time.sleep(30)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_wait(ray_start):
+    import time
+
+    @ray_tpu.remote
+    def sleeper(t):
+        time.sleep(t)
+        return t
+
+    fast = sleeper.remote(0.01)
+    slow = sleeper.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=10)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_nested_tasks(ray_start):
+    @ray_tpu.remote
+    def outer(n):
+        refs = [f_add.remote(i, i) for i in range(n)]
+        return sum(ray_tpu.get(refs))
+
+    assert ray_tpu.get(outer.options(num_cpus=0.5).remote(3)) == 6
+
+
+def test_nested_ref_in_container(ray_start):
+    inner = ray_tpu.put(np.arange(200_000))  # plasma object
+
+    @ray_tpu.remote
+    def consume(d):
+        return int(ray_tpu.get(d["ref"]).sum())
+
+    assert ray_tpu.get(consume.remote({"ref": inner})) == \
+        int(np.arange(200_000).sum())
+
+
+def test_cluster_resources(ray_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 16.0
+
+
+def test_jax_array_roundtrip(ray_start):
+    import jax.numpy as jnp
+
+    x = jnp.arange(32, dtype=jnp.float32)
+    out = ray_tpu.get(ray_tpu.put(x))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
