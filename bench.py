@@ -1,0 +1,78 @@
+"""Headline benchmark: GPT-2-small training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's north-star is GPT-2 DDP samples/sec/chip on
+A100+NCCL (BASELINE.json); a 124M-param GPT-2 at seq 1024 trains at roughly
+18 samples/s/A100 under torch DDP in the reference's release setup
+(release/air_tests/air_benchmarks/workloads/torch_benchmark.py equivalent).
+vs_baseline = ours / 18.0 — >1.0 means we beat the per-chip baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 18.0
+
+
+def main():
+    import optax
+
+    from ray_tpu.models.gpt import (GPTConfig, gpt_init, gpt_param_axes,
+                                    make_train_step)
+    from ray_tpu.parallel import LogicalAxisRules, MeshSpec
+    from ray_tpu.parallel.sharding import shard_params
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    cfg = GPTConfig.gpt2_small() if on_tpu else GPTConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "max_seq_len": seq,
+                       "attention": "flash" if on_tpu else "dense"})
+
+    n = len(jax.devices())
+    spec = MeshSpec.for_devices(n)
+    mesh = spec.build()
+    rules = LogicalAxisRules.for_transformer(spec)
+
+    with jax.sharding.set_mesh(mesh):
+        params = gpt_init(jax.random.PRNGKey(0), cfg)
+        params = shard_params(params, mesh, rules, gpt_param_axes(cfg))
+        tx = optax.adamw(3e-4, b2=0.95)
+        opt_state = tx.init(params)
+        step = make_train_step(cfg, tx, rules)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size,
+            jnp.int32)
+        batch_dict = {"tokens": tokens}
+
+        # warmup / compile (float() forces a device sync — block_until_ready
+        # is not reliable on the experimental axon platform)
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, batch_dict)
+        float(m["loss"])
+
+        iters = 10 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, m = step(params, opt_state, batch_dict)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+
+    samples_per_sec = iters * batch / dt
+    per_chip = samples_per_sec / n
+    print(json.dumps({
+        "metric": "gpt2_small_train_samples_per_sec_per_chip"
+                  if on_tpu else "gpt2_tiny_cpu_smoke_samples_per_sec",
+        "value": round(per_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,9 +45,13 @@ async def amain(args) -> None:
     # _private/resource_spec.py:287). Only the head claims real chips.
     if args.head and not args.no_tpu_detect:
         try:
+            from ray_tpu._private import tpu_topology
+            resources = {**tpu_topology.detect().resource_dict(),
+                         **resources}
             chips = _detect_tpu_chips()
             if chips:
                 resources.setdefault("TPU", float(chips))
+            if "TPU" in resources:
                 resources.setdefault("tpu-host", 1.0)
         except Exception:
             pass

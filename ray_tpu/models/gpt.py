@@ -1,0 +1,255 @@
+"""GPT-2-class decoder-only transformer, TPU-first.
+
+The reference's north-star training benchmark is GPT-2 DDP under Ray Train
+(`release/air_tests/air_benchmarks/`); this is the equivalent flagship model,
+but designed for the MXU rather than ported: bf16 compute / f32 params & o
+ptimizer state, layers stacked into one scanned [L, ...] pytree (single XLA
+while-loop, constant compile time in depth, and the layer dim doubles as the
+pipeline-parallel shard axis), logical-axis annotations on every param so the
+same definition runs dp/fsdp/tp/pp/sp via `ray_tpu.parallel` rule tables,
+`jax.checkpoint` rematerialization per layer, and a pluggable attention body
+(dense causal or ring attention from `ray_tpu.ops`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.parallel.sharding import LogicalAxisRules, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 padded to a multiple of 128
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16        # compute dtype (params stay f32)
+    remat: bool = True
+    attention: str = "dense"         # "dense" | "ring" (ring needs sp>1)
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.mlp_ratio * self.embed_dim
+
+    @staticmethod
+    def gpt2_small() -> "GPTConfig":
+        return GPTConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 256, seq: int = 128) -> "GPTConfig":
+        return GPTConfig(vocab_size=vocab, max_seq_len=seq, num_layers=2,
+                         num_heads=4, embed_dim=64)
+
+
+def gpt_init(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
+    """Initialize params. Per-layer weights are stacked on a leading [L] dim."""
+    k = jax.random.split(rng, 8)
+    D, H, M, L, V = (cfg.embed_dim, cfg.head_dim, cfg.mlp_dim,
+                     cfg.num_layers, cfg.vocab_size)
+    nh = cfg.num_heads
+    scale = 0.02
+    # residual-branch projections get the GPT-2 depth-scaled init
+    rscale = scale / np.sqrt(2 * L)
+
+    def norm(shape):
+        return {"scale": jnp.ones(shape, jnp.float32),
+                "bias": jnp.zeros(shape, jnp.float32)}
+
+    return {
+        "wte": scale * jax.random.normal(k[0], (V, D), jnp.float32),
+        "wpe": scale * jax.random.normal(k[1], (cfg.max_seq_len, D),
+                                         jnp.float32),
+        "layers": {
+            "ln1": norm((L, D)),
+            "attn": {
+                "wqkv": scale * jax.random.normal(
+                    k[2], (L, D, 3, nh, H), jnp.float32),
+                "wo": rscale * jax.random.normal(
+                    k[3], (L, nh, H, D), jnp.float32),
+                "bo": jnp.zeros((L, D), jnp.float32),
+            },
+            "ln2": norm((L, D)),
+            "mlp": {
+                "wi": scale * jax.random.normal(k[4], (L, D, M), jnp.float32),
+                "bi": jnp.zeros((L, M), jnp.float32),
+                "wo": rscale * jax.random.normal(k[5], (L, M, D), jnp.float32),
+                "bo": jnp.zeros((L, D), jnp.float32),
+            },
+        },
+        "ln_f": norm((D,)),
+    }
+
+
+def gpt_param_axes(cfg: GPTConfig) -> Dict[str, Any]:
+    """Logical-axis annotation pytree matching `gpt_init`'s output."""
+    return {
+        # wte sharded on embed (not vocab): token lookup is a gather, and a
+        # vocab-sharded gather forces SPMD full rematerialization; the tied
+        # LM head contracts over embed so fsdp-sharding it is free (psum).
+        "wte": (None, "embed"),
+        "wpe": (None, "embed"),
+        "layers": {
+            "ln1": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "attn": {
+                "wqkv": ("layers", "embed", None, "heads", "kv"),
+                "wo": ("layers", "heads", "kv", "embed"),
+                "bo": ("layers", "norm"),
+            },
+            "ln2": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
+            "mlp": {
+                "wi": ("layers", "embed", "mlp"),
+                "bi": ("layers", "mlp"),
+                "wo": ("layers", "mlp", "embed"),
+                "bo": ("layers", "norm"),
+            },
+        },
+        "ln_f": {"scale": ("norm",), "bias": ("norm",)},
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _dense_causal_attention(q, k, v):
+    """[B,S,N,H] bf16 attention with causal mask; softmax in f32."""
+    S = q.shape[1]
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k) / np.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
+           attn_fn: Callable, x, layer_params):
+    """One transformer block. `layer_params` has the [L] dim already sliced."""
+    lc = (lambda a, ax: with_logical_constraint(a, rules, ax)) if rules \
+        else (lambda a, ax: a)
+    p = layer_params
+    dt = cfg.dtype
+
+    h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = jnp.einsum("bsd,dcnh->bscnh", h, p["attn"]["wqkv"].astype(dt))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = lc(q, ("batch", "seq", "heads", "kv"))
+    k = lc(k, ("batch", "seq", "heads", "kv"))
+    v = lc(v, ("batch", "seq", "heads", "kv"))
+    o = attn_fn(q, k, v)
+    o = jnp.einsum("bsnh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+    x = x + o + p["attn"]["bo"].astype(dt)
+    x = lc(x, ("batch", "seq", "embed"))
+
+    h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = jnp.einsum("bsd,dm->bsm", h, p["mlp"]["wi"].astype(dt)) \
+        + p["mlp"]["bi"].astype(dt)
+    h = lc(h, ("batch", "seq", "mlp"))
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsm,md->bsd", h, p["mlp"]["wo"].astype(dt)) \
+        + p["mlp"]["bo"].astype(dt)
+    x = x + h
+    return lc(x, ("batch", "seq", "embed"))
+
+
+def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
+                rules: Optional[LogicalAxisRules] = None,
+                mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (f32).
+
+    Layers run under one `lax.scan` over the stacked [L] params — XLA sees a
+    single while-loop body (fast compiles, and the [L] dim shards over pp).
+    With ``cfg.attention == "ring"`` and a mesh, attention runs as ring
+    attention shard_mapped over the `sp` axis (KV rotating via ppermute).
+    """
+    dt = cfg.dtype
+    B, S = tokens.shape
+    if cfg.attention == "ring" and mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+        spec = P(("dp", "fsdp"), "sp", "tp", None)
+        attn_fn = jax.shard_map(
+            functools.partial(ring_attention_sharded, axis_name="sp"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    elif cfg.attention == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        attn_fn = flash_attention
+    else:
+        attn_fn = _dense_causal_attention
+
+    x = params["wte"].astype(dt)[tokens] \
+        + params["wpe"].astype(dt)[:S][None]
+    if rules is not None:
+        x = with_logical_constraint(x, rules, ("batch", "seq", "embed"))
+
+    block = functools.partial(_block, cfg, rules, attn_fn)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
+             rules: Optional[LogicalAxisRules] = None, mesh=None) -> jax.Array:
+    """Next-token cross-entropy. batch: {"tokens": [B, S+1] int32}."""
+    toks = batch["tokens"]
+    logits = gpt_forward(params, toks[:, :-1], cfg, rules, mesh)
+    targets = toks[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------- train step
+
+def make_train_state(rng, cfg: GPTConfig, learning_rate: float = 3e-4,
+                     weight_decay: float = 0.1):
+    """(params, opt_state, optimizer) with AdamW."""
+    import optax
+    params = gpt_init(rng, cfg)
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95,
+                     weight_decay=weight_decay)
+    return params, tx.init(params), tx
+
+
+def make_train_step(cfg: GPTConfig, tx,
+                    rules: Optional[LogicalAxisRules] = None,
+                    mesh=None, donate: bool = True):
+    """Returns jittable (params, opt_state, batch) -> (params, opt_state,
+    metrics).  Under a Mesh + sharded inputs, XLA emits all collectives
+    (gradient reduction across dp/fsdp, tp/sp activation collectives) — the
+    TPU equivalent of the reference's DDP allreduce hook."""
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(gpt_loss)(params, batch, cfg, rules,
+                                                   mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,39 @@
+"""Minimal MLP classifier — the "MNIST milestone" model (SURVEY §7 step 4)
+and the workhorse for fast train/tune tests (reference analogue: the torch
+linear models in `train/tests/test_data_parallel_trainer.py`)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(rng: jax.Array, sizes: List[int]) -> Dict:
+    keys = jax.random.split(rng, len(sizes) - 1)
+    return {
+        f"layer{i}": {
+            "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                                   jnp.float32) / jnp.sqrt(sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],), jnp.float32),
+        }
+        for i in range(len(sizes) - 1)
+    }
+
+
+def mlp_forward(params: Dict, x: jax.Array) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        p = params[f"layer{i}"]
+        x = x @ p["w"] + p["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params: Dict, batch: Dict[str, jax.Array]) -> jax.Array:
+    logits = mlp_forward(params, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, batch["y"][:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)

@@ -1,0 +1,56 @@
+"""Thin functional collectives for use inside shard_map-ped code.
+
+Reference analogue: `ray.util.collective` op surface (allreduce/allgather/
+reducescatter/broadcast/send/recv/barrier, `util/collective/collective.py:
+258-615`).  There the ops are runtime NCCL calls between actor processes; here
+they are `jax.lax` primitives that XLA lowers to ICI collectives inside a
+compiled program.  The host-driven, actor-to-actor veneer with the reference's
+exact API shape lives in `ray_tpu.util.collective`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def psum_scatter(x, axis_name: str, scatter_dimension: int = 0):
+    """Reduce-scatter: the building block of efficient DP gradient sync."""
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    """Ulysses-style head<->sequence reshuffle, MoE token dispatch."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True)
+
+
+def ppermute_ring(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the ring — the ring-attention KV step."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def barrier_sum(axis_name: str):
+    """Cheapest full-axis synchronization inside a program."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)

@@ -13,6 +13,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The environment may pin JAX_PLATFORMS to a TPU plugin; config.update
+# overrides it as long as no backend has been initialized yet (a
+# sitecustomize that already called jax.devices() would defeat both this
+# and the env var — in that case tests fail loudly on device count).
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
