@@ -52,6 +52,13 @@ class Worker:
         if self.connected:
             return self.connection_info()
         self.namespace = namespace or "default"
+        # Same-machine workers must be able to import the driver's modules
+        # (reference: workers inherit the driver's environment on a local
+        # cluster; multi-node code shipping is runtime_env working_dir).
+        _worker_env = dict(_worker_env or {})
+        _worker_env.setdefault(
+            "RT_DRIVER_SYS_PATH",
+            os.pathsep.join(p or os.getcwd() for p in sys.path))
         if address is None:
             self._start_local_cluster(num_cpus, resources, object_store_memory,
                                       log_level, _worker_env)

@@ -199,6 +199,11 @@ def main():
     raylet_address = os.environ["RT_RAYLET_ADDRESS"]
     gcs_address = os.environ["RT_GCS_ADDRESS"]
     store_name = os.environ["RT_STORE_NAME"]
+    driver_sys_path = os.environ.get("RT_DRIVER_SYS_PATH")
+    if driver_sys_path:
+        for p in reversed(driver_sys_path.split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
     _set_proc_title("ray_tpu::worker")
 
     core = CoreWorker(

@@ -1,0 +1,29 @@
+"""AIR-style shared trainer/tuner surface.
+
+Design analog: reference ``python/ray/air/`` -- Checkpoint
+(air/checkpoint.py:63), ScalingConfig/FailureConfig/CheckpointConfig/
+RunConfig (air/config.py:79,483,542,670), session.report (air/session.py:41),
+Result (air/result.py).  Re-designed for JAX: checkpoints hold pytrees
+natively (flax.serialization msgpack + numpy arrays), ScalingConfig speaks
+TPU hosts/chips instead of GPUs.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+    "session",
+]

@@ -1,0 +1,26 @@
+"""Distributed training orchestration (Ray Train equivalent, TPU-first).
+
+Design analog: reference ``python/ray/train/`` -- BaseTrainer.fit
+(base_trainer.py:339), DataParallelTrainer (data_parallel_trainer.py:56),
+BackendExecutor (_internal/backend_executor.py:43), WorkerGroup
+(_internal/worker_group.py:92).  The framework backend is JAX: instead of
+``dist.init_process_group(nccl)`` (train/torch/config.py:113) workers run
+``jax.distributed.initialize`` so in-slice collectives compile into the
+pjit step over ICI.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+from ray_tpu.train.jax.jax_trainer import JaxTrainer
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BaseTrainer",
+    "TrainingFailedError",
+    "DataParallelTrainer",
+    "JaxConfig",
+    "JaxTrainer",
+]

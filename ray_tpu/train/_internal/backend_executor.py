@@ -1,0 +1,162 @@
+"""BackendExecutor: drives a training run over a WorkerGroup.
+
+Design analog: reference ``python/ray/train/_internal/backend_executor.py:43``
+-- placement-group creation (:138), rank assignment (:245), start_training
+(:315), worker-failure handling (:510,571).  TPU-first deltas: ranks map to
+hosts of a slice; a lost worker means the whole slice restarts from the last
+checkpoint (slice is all-or-nothing, SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainBackendError(RuntimeError):
+    pass
+
+
+class TrainingWorkerError(RuntimeError):
+    """A worker died or the train fn raised; carries the remote traceback."""
+
+    def __init__(self, msg: str, traceback_str: str = ""):
+        super().__init__(msg + ("\n" + traceback_str if traceback_str else ""))
+        self.traceback_str = traceback_str
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig,
+                 max_failures: int = 0):
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()()
+        self._scaling = scaling_config
+        self._max_failures = max_failures
+        self._num_failures = 0
+        self._pg = None
+        self._group: Optional[WorkerGroup] = None
+        self._pending: List[Any] = []
+        self._finished: List[bool] = []
+        self._latest_checkpoint: Optional[Checkpoint] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        sc = self._scaling
+        bundles = [sc.bundle() for _ in range(sc.num_workers)]
+        self._pg = placement_group(bundles, strategy=sc.placement_strategy)
+        if not self._pg.ready(timeout=60.0):
+            remove_placement_group(self._pg)
+            self._pg = None
+            raise TrainBackendError(
+                f"placement group for {sc.num_workers} x {sc.bundle()} "
+                "could not be scheduled (insufficient cluster resources)")
+        self._group = WorkerGroup(sc.num_workers, sc.bundle(),
+                                  placement_group=self._pg)
+        for w in self._group.workers:
+            w.actor.set_context.remote(
+                world_rank=w.rank,
+                world_size=sc.num_workers,
+                local_rank=w.local_rank,
+                local_world_size=self._group.local_world_size(w.ip),
+                node_rank=w.node_rank,
+            )
+        self._backend.on_start(self._group, self._backend_config)
+
+    def start_training(self, train_fn: Callable,
+                       config: Optional[Dict[str, Any]] = None,
+                       checkpoint: Optional[Checkpoint] = None):
+        if self._group is None:
+            raise TrainBackendError("executor not started")
+        self._backend.on_training_start(self._group, self._backend_config)
+        if checkpoint is not None:
+            self._latest_checkpoint = checkpoint
+        refs = [w.actor.start_training.remote(
+                    train_fn, config, self._latest_checkpoint)
+                for w in self._group.workers]
+        ray_tpu.get(refs)
+        self._finished = [False] * len(self._group)
+        self._train_fn = train_fn
+        self._config = config
+
+    # -- result pump ------------------------------------------------------
+    def get_next_results(self) -> Optional[List[Dict[str, Any]]]:
+        """One bundle of per-worker reports for the same iteration, or None
+        when every worker's train fn returned (reference
+        backend_executor.py:414: all-or-nothing consistency check)."""
+        if all(self._finished):
+            return None
+        out: List[Optional[Dict[str, Any]]] = [None] * len(self._group)
+        for i, w in enumerate(self._group.workers):
+            if self._finished[i]:
+                continue
+            try:
+                kind, payload, extra = ray_tpu.get(w.actor.get_next.remote())
+            except Exception as e:
+                raise TrainingWorkerError(
+                    f"worker rank={i} died during training: {e}") from e
+            if kind == "error":
+                raise TrainingWorkerError(
+                    f"train loop failed on rank={i}: {payload}", extra or "")
+            if kind == "done":
+                self._finished[i] = True
+                continue
+            metrics, ckpt = payload, extra
+            if ckpt is not None and i == 0:
+                # Rank-0 checkpoint wins (reference keeps rank-0's).
+                self._latest_checkpoint = ckpt
+            out[i] = metrics
+        if all(self._finished):
+            return None
+        live = [m for m in out if m is not None]
+        if live and len(live) != sum(1 for f in self._finished if not f):
+            raise TrainBackendError(
+                "workers reported unevenly: every live worker must call "
+                "session.report() the same number of times")
+        return live if live else None
+
+    def recover(self, train_fn: Callable,
+                config: Optional[Dict[str, Any]]) -> bool:
+        """Tear down and restart the gang from the latest checkpoint.
+        Returns False when failure budget is exhausted."""
+        self._num_failures += 1
+        if self._max_failures >= 0 and self._num_failures > self._max_failures:
+            return False
+        logger.warning("train worker failure %d/%s; restarting group",
+                       self._num_failures, self._max_failures)
+        self._teardown_group()
+        self.start()
+        self.start_training(train_fn, config, self._latest_checkpoint)
+        return True
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return self._latest_checkpoint
+
+    def _teardown_group(self):
+        if self._group is not None:
+            try:
+                self._backend.on_shutdown(self._group, self._backend_config)
+            except Exception:
+                pass
+            self._group.shutdown()
+            self._group = None
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+
+    def shutdown(self):
+        self._teardown_group()

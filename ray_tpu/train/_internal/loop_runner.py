@@ -1,0 +1,61 @@
+"""Direct (non-Tune) trainer execution.
+
+Design analog: the reference always routes Trainer.fit through a
+single-trial Tune run (base_trainer.py:339).  Here the direct path is
+first-class -- a driver-side session collects session.report calls from
+training_loop and materializes an air.Result -- while Tuner(trainer) still
+layers the full Tune machinery on top.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air import session as air_session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+
+
+class _DriverSession(air_session._SessionBase):
+    """Accumulates reports made by the trainer's training_loop."""
+
+    def __init__(self, stop: Optional[Dict[str, Any]] = None):
+        self.history: List[Dict[str, Any]] = []
+        self.latest_checkpoint: Optional[Checkpoint] = None
+        self._stop = stop or {}
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", self.iteration)
+        self.history.append(metrics)
+        if checkpoint is not None:
+            self.latest_checkpoint = checkpoint
+
+
+def run_trainer_directly(trainer) -> Result:
+    from ray_tpu.train.base_trainer import TrainingFailedError
+
+    prev = air_session._get_session()
+    sess = _DriverSession(stop=trainer.run_config.stop)
+    air_session._set_session(sess)
+    error: Optional[Exception] = None
+    try:
+        trainer.training_loop()
+    except Exception as e:  # noqa: BLE001 - surfaced in Result + raised
+        error = e
+    finally:
+        air_session._set_session(prev)
+
+    result = Result(
+        metrics=sess.history[-1] if sess.history else {},
+        checkpoint=sess.latest_checkpoint,
+        error=error,
+        metrics_history=sess.history,
+    )
+    if error is not None:
+        raise TrainingFailedError(
+            f"training loop failed: {error}") from error
+    return result

@@ -1,0 +1,39 @@
+"""JaxTrainer: the flagship DataParallelTrainer flavour.
+
+Design analog: reference ``python/ray/train/torch/torch_trainer.py``
+(TorchTrainer = DataParallelTrainer + TorchConfig).  The worker fn is the
+per-process half of an SPMD program: build a Mesh over jax.devices(),
+shard the batch on the data axis with pjit, and let XLA emit ICI
+collectives -- see ray_tpu.parallel for mesh/sharding helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _backend_config_cls = JaxConfig
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 jax_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)

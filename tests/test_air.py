@@ -1,0 +1,65 @@
+"""AIR layer tests (reference: python/ray/air/tests/test_checkpoints.py
+shape: dict<->dir round trips; config validation)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.air import (Checkpoint, CheckpointConfig, FailureConfig,
+                         RunConfig, ScalingConfig)
+
+
+def test_checkpoint_dict_roundtrip(tmp_path):
+    ckpt = Checkpoint.from_dict({"step": 7, "weights": [1, 2, 3]})
+    d = ckpt.to_dict()
+    assert d["step"] == 7
+
+    path = ckpt.to_directory(str(tmp_path / "c1"))
+    restored = Checkpoint.from_directory(path)
+    d2 = restored.to_dict()
+    assert d2 == d
+
+
+def test_checkpoint_pytree_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    tree = {"layer": {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)},
+            "scale": np.float32(2.0)}
+    ckpt = Checkpoint.from_pytree(tree, step=3)
+    path = ckpt.to_directory(str(tmp_path / "c2"))
+    restored = Checkpoint.from_directory(path)
+    out = restored.to_pytree()
+    np.testing.assert_array_equal(np.asarray(out["layer"]["w"]),
+                                  np.ones((4, 4)))
+    assert restored.to_dict()["step"] == 3
+
+
+def test_checkpoint_bytes_and_pack(tmp_path):
+    ckpt = Checkpoint.from_dict({"x": 1})
+    assert Checkpoint.from_bytes(ckpt.to_bytes()).to_dict()["x"] == 1
+    packed = ckpt.as_pack()
+    assert Checkpoint.from_pack(packed).to_dict()["x"] == 1
+
+
+def test_checkpoint_exactly_one_form():
+    with pytest.raises(ValueError):
+        Checkpoint()
+    with pytest.raises(ValueError):
+        Checkpoint(local_path="/tmp/x", data_dict={})
+
+
+def test_scaling_config_bundles():
+    sc = ScalingConfig(num_workers=4, use_tpu=True, chips_per_worker=4)
+    assert sc.bundle() == {"CPU": 1.0, "TPU": 4.0}
+    assert sc.num_chips_total == 16
+    bundles = sc.as_placement_group_bundles()
+    assert len(bundles) == 4
+
+
+def test_run_config_defaults():
+    rc = RunConfig()
+    assert rc.failure_config.max_failures == 0
+    assert rc.checkpoint_config.num_to_keep is None
+    with pytest.raises(ValueError):
+        CheckpointConfig(checkpoint_score_order="bogus")
+    assert FailureConfig(max_failures=-1).max_failures == -1
