@@ -79,9 +79,9 @@ def _detect_from_env() -> Optional[TpuTopology]:
     except ValueError:
         chips_per_host = _CHIPS_PER_HOST_DEFAULT
     chips_per_host = max(1, chips_per_host)
-    # v2/v3/v4 accelerator types count TensorCores (2 per chip): N//2
-    # chips.  v5e/v5p/v6e count chips directly.
-    num_chips = total // 2 if gen in ("v2", "v3", "v4") else total
+    # v2/v3/v4/v5p accelerator types count TensorCores (2 per chip): N//2
+    # chips.  v5e/v6e (litepod) count chips directly.
+    num_chips = total // 2 if gen in ("v2", "v3", "v4", "v5p") else total
     hosts = max(1, num_chips // chips_per_host)
     try:
         host_index = int(os.environ.get("TPU_WORKER_ID") or 0)

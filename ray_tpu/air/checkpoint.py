@@ -22,12 +22,6 @@ from typing import Any, Dict, Optional
 _DICT_FILE = "checkpoint_dict.pkl"
 _PYTREE_DIR = "pytree"
 _PYTREE_META = "pytree_structure.json"
-_METADATA_FILE = "checkpoint_metadata.json"
-
-
-def _is_jax_array(x) -> bool:
-    mod = type(x).__module__
-    return mod.startswith("jax") or mod.startswith("numpy")
 
 
 class Checkpoint:
@@ -46,7 +40,6 @@ class Checkpoint:
                 "(use Checkpoint.from_dict / Checkpoint.from_directory)")
         self._local_path = local_path
         self._data_dict = data_dict
-        self._metadata: Dict[str, Any] = {}
 
     # -- constructors -----------------------------------------------------
     @classmethod
@@ -105,9 +98,6 @@ class Checkpoint:
             _save_pytree(tree, path)
         with open(os.path.join(path, _DICT_FILE), "wb") as f:
             pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
-        if self._metadata:
-            with open(os.path.join(path, _METADATA_FILE), "w") as f:
-                json.dump(self._metadata, f)
         return path
 
     def to_bytes(self) -> bytes:

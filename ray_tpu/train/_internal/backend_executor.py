@@ -53,7 +53,10 @@ class BackendExecutor:
     # -- lifecycle --------------------------------------------------------
     def start(self):
         sc = self._scaling
-        bundles = [sc.bundle() for _ in range(sc.num_workers)]
+        # Head bundle (trainer_resources) first, then one bundle per worker
+        # (reference backend_executor.py:138).
+        bundles = sc.as_placement_group_bundles()
+        worker_offset = len(bundles) - sc.num_workers
         self._pg = placement_group(bundles, strategy=sc.placement_strategy)
         if not self._pg.ready(timeout=60.0):
             remove_placement_group(self._pg)
@@ -62,7 +65,8 @@ class BackendExecutor:
                 f"placement group for {sc.num_workers} x {sc.bundle()} "
                 "could not be scheduled (insufficient cluster resources)")
         self._group = WorkerGroup(sc.num_workers, sc.bundle(),
-                                  placement_group=self._pg)
+                                  placement_group=self._pg,
+                                  bundle_offset=worker_offset)
         for w in self._group.workers:
             w.actor.set_context.remote(
                 world_rank=w.rank,
@@ -97,25 +101,37 @@ class BackendExecutor:
         if all(self._finished):
             return None
         out: List[Optional[Dict[str, Any]]] = [None] * len(self._group)
+        # Issue one get_next per live worker and collect via wait() so an
+        # error raised on any rank surfaces immediately, even while other
+        # ranks hang inside a collective waiting for the dead peer
+        # (reference backend_executor uses ray.wait the same way).
+        ref_to_rank = {}
         for i, w in enumerate(self._group.workers):
-            if self._finished[i]:
-                continue
-            try:
-                kind, payload, extra = ray_tpu.get(w.actor.get_next.remote())
-            except Exception as e:
-                raise TrainingWorkerError(
-                    f"worker rank={i} died during training: {e}") from e
-            if kind == "error":
-                raise TrainingWorkerError(
-                    f"train loop failed on rank={i}: {payload}", extra or "")
-            if kind == "done":
-                self._finished[i] = True
-                continue
-            metrics, ckpt = payload, extra
-            if ckpt is not None and i == 0:
-                # Rank-0 checkpoint wins (reference keeps rank-0's).
-                self._latest_checkpoint = ckpt
-            out[i] = metrics
+            if not self._finished[i]:
+                ref_to_rank[w.actor.get_next.remote()] = i
+        remaining = list(ref_to_rank)
+        while remaining:
+            ready, remaining = ray_tpu.wait(
+                remaining, num_returns=len(remaining), timeout=5.0)
+            for ref in ready:
+                i = ref_to_rank[ref]
+                try:
+                    kind, payload, extra = ray_tpu.get(ref)
+                except Exception as e:
+                    raise TrainingWorkerError(
+                        f"worker rank={i} died during training: {e}") from e
+                if kind == "error":
+                    raise TrainingWorkerError(
+                        f"train loop failed on rank={i}: {payload}",
+                        extra or "")
+                if kind == "done":
+                    self._finished[i] = True
+                    continue
+                metrics, ckpt = payload, extra
+                if ckpt is not None and i == 0:
+                    # Rank-0 checkpoint wins (reference keeps rank-0's).
+                    self._latest_checkpoint = ckpt
+                out[i] = metrics
         if all(self._finished):
             return None
         live = [m for m in out if m is not None]

@@ -16,6 +16,11 @@ from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.result import Result
 
 
+class StopTraining(Exception):
+    """Raised into the trainer's loop when RunConfig.stop criteria are met;
+    the training_loop treats it as a clean early exit."""
+
+
 class _DriverSession(air_session._SessionBase):
     """Accumulates reports made by the trainer's training_loop."""
 
@@ -33,6 +38,14 @@ class _DriverSession(air_session._SessionBase):
         self.history.append(metrics)
         if checkpoint is not None:
             self.latest_checkpoint = checkpoint
+        if self._should_stop(metrics):
+            raise StopTraining()
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        for key, threshold in self._stop.items():
+            if key in metrics and metrics[key] >= threshold:
+                return True
+        return False
 
 
 def run_trainer_directly(trainer) -> Result:
@@ -44,6 +57,8 @@ def run_trainer_directly(trainer) -> Result:
     error: Optional[Exception] = None
     try:
         trainer.training_loop()
+    except StopTraining:
+        pass  # RunConfig.stop criteria met: clean early exit
     except Exception as e:  # noqa: BLE001 - surfaced in Result + raised
         error = e
     finally:

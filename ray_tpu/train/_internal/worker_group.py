@@ -40,14 +40,16 @@ class RayTrainWorker:
         return fn(*args, **kwargs)
 
     def node_ip(self) -> str:
-        return socket.gethostbyname(socket.gethostname())
-
-    def free_port(self) -> int:
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
+        # UDP-connect trick: finds the address of the interface that routes
+        # externally (gethostbyname(hostname) often resolves to 127.0.1.1).
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+        finally:
+            s.close()
 
     def set_env_vars(self, env: Dict[str, str]):
         os.environ.update(env)

@@ -1,0 +1,30 @@
+"""Hyperparameter tuning (Ray Tune equivalent).
+
+Design analog: reference ``python/ray/tune/`` -- Tuner.fit (tuner.py:249),
+TrialRunner event loop (execution/trial_runner.py:969), Trainable contract
+(trainable/trainable.py:66), search spaces (tune/search/), schedulers
+(tune/schedulers/: ASHA async_hyperband.py, PBT pbt.py, median stopping).
+Trials are actors gang-placed like any other workload; a trial whose
+Trainable is a JaxTrainer runs a nested worker gang (SPMD program) on its
+slice.
+"""
+
+from ray_tpu.tune.search.sample import (
+    choice, grid_search, lograndint, loguniform, qrandint, quniform,
+    randint, randn, uniform, sample_from)
+from ray_tpu.tune.trainable import Trainable, with_parameters, with_resources
+from ray_tpu.tune.tune_config import TuneConfig
+from ray_tpu.tune.tuner import Tuner
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.air import session as _session
+
+# Function-API report surface (reference: ray.tune.report / air session).
+report = _session.report
+get_checkpoint = _session.get_checkpoint
+
+__all__ = [
+    "Trainable", "TuneConfig", "Tuner", "ResultGrid",
+    "choice", "grid_search", "lograndint", "loguniform", "qrandint",
+    "quniform", "randint", "randn", "uniform", "sample_from",
+    "with_parameters", "with_resources", "report", "get_checkpoint",
+]

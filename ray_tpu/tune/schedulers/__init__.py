@@ -1,0 +1,10 @@
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    FIFOScheduler, TrialScheduler)
+from ray_tpu.tune.schedulers.asha import ASHAScheduler
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+__all__ = [
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

@@ -1,0 +1,27 @@
+"""TuneConfig.
+
+Design analog: reference ``python/ray/tune/tune_config.py`` (TuneConfig
+dataclass: metric/mode/search_alg/scheduler/num_samples/
+max_concurrent_trials).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[object] = None
+    scheduler: Optional[object] = None
+    reuse_actors: bool = False
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.mode is not None and self.mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")

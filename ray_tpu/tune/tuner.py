@@ -1,0 +1,95 @@
+"""Tuner: the user-facing tuning entry point.
+
+Design analog: reference ``python/ray/tune/tuner.py`` (Tuner.fit:249 ->
+tune.run -> TrialRunner loop) plus ``Tuner.restore`` for experiment resume.
+Accepts a function, a Trainable subclass, or a train.BaseTrainer (wrapped
+via as_trainable, mirroring base_trainer.py:500).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Type, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune.execution.trial_runner import TrialRunner
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_config import TuneConfig
+
+
+def _to_trainable_cls(trainable) -> Type[Trainable]:
+    from ray_tpu.train.base_trainer import BaseTrainer
+    if isinstance(trainable, BaseTrainer):
+        return trainable.as_trainable()
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        return trainable
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"cannot tune {type(trainable)}")
+
+
+class Tuner:
+    def __init__(self,
+                 trainable: Union[Callable, Type[Trainable], Any],
+                 *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _restore_path: Optional[str] = None):
+        self._trainable_cls = _to_trainable_cls(trainable)
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._restore_path = _restore_path
+
+    @classmethod
+    def restore(cls, path: str, trainable) -> "Tuner":
+        return cls(trainable, _restore_path=path)
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        searcher = tc.search_alg
+        if searcher is None:
+            searcher = BasicVariantGenerator(
+                self._param_space, num_samples=tc.num_samples, seed=tc.seed)
+        elif isinstance(searcher, Searcher):
+            searcher.set_search_properties(tc.metric, tc.mode or "max",
+                                           self._param_space)
+
+        name = self._run_config.name or "tune_experiment"
+        storage = self._run_config.storage_path
+        if storage:
+            storage = os.path.join(storage, name)
+
+        runner = TrialRunner(
+            self._trainable_cls,
+            searcher=searcher,
+            scheduler=tc.scheduler,
+            metric=tc.metric,
+            mode=tc.mode or "max",
+            max_concurrent=tc.max_concurrent_trials,
+            stop=self._run_config.stop,
+            max_failures=self._run_config.failure_config.max_failures,
+            experiment_name=name,
+            storage_path=storage,
+        )
+        if self._restore_path:
+            runner.restore_experiment_state(self._restore_path)
+        runner.run_until_done()
+        return ResultGrid(
+            [self._trial_to_result(t) for t in runner.trials],
+            metric=tc.metric, mode=tc.mode or "max")
+
+    @staticmethod
+    def _trial_to_result(trial: Trial) -> Result:
+        return Result(
+            metrics=trial.last_result or None,
+            checkpoint=trial.checkpoint,
+            error=RuntimeError(trial.error) if trial.error else None,
+            metrics_history=trial.metrics_history,
+        )

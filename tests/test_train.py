@@ -181,3 +181,19 @@ def test_trainer_dataset_sharding(ray_start):
     )
     result = trainer.fit()
     assert result.metrics["n_items"] == 4
+
+
+def _loop_many(config):
+    for i in range(50):
+        session.report({"loss": float(i)})
+
+
+def test_run_config_stop_criteria(ray_start):
+    trainer = JaxTrainer(
+        _loop_many,
+        jax_config=JaxConfig(distributed=False),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(stop={"training_iteration": 5}),
+    )
+    result = trainer.fit()
+    assert len(result.metrics_history) == 5
