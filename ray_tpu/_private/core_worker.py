@@ -390,10 +390,18 @@ class CoreWorker:
     # ------------------------------------------------------------ args
 
     def serialize_args(self, args: tuple, kwargs: dict):
-        """Each arg becomes ("v", bytes) inline, or ("ref", hex, owner)."""
+        """Each arg becomes ("v", bytes) inline, or ("ref", hex, owner).
+
+        Also returns the ObjectRefs that ride as refs: the submitter must
+        hold them until the task completes, or an owner seeing its local
+        count hit zero would eagerly free a value an in-flight task still
+        needs (reference: ReferenceCounter submitted-task references,
+        reference_count.h:61)."""
         out_args = [self._serialize_one(a) for a in args]
         out_kwargs = {k: self._serialize_one(v) for k, v in kwargs.items()}
-        return out_args, out_kwargs
+        pinned = [a for a in args if isinstance(a, ObjectRef)]
+        pinned += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        return out_args, out_kwargs, pinned
 
     def _serialize_one(self, value):
         if isinstance(value, ObjectRef):
@@ -439,7 +447,7 @@ class CoreWorker:
                     name=None) -> List[ObjectRef]:
         fid = self.export_function(func)
         task_id = task_id_generator.next()
-        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(num_returns)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
@@ -456,14 +464,15 @@ class CoreWorker:
         resources = dict(resources or {"CPU": 1.0})
         asyncio.run_coroutine_threadsafe(
             self._submit_and_track(spec, resources, scheduling, max_retries,
-                                   retry_exceptions, return_ids),
+                                   retry_exceptions, return_ids, pinned_args),
             self.loop)
         for oid in return_ids:
             self.owned.add(oid.hex())
         return refs
 
     async def _submit_and_track(self, spec, resources, scheduling, max_retries,
-                                retry_exceptions, return_ids):
+                                retry_exceptions, return_ids,
+                                pinned_args=None):
         attempts = max_retries + 1
         last_err: Optional[BaseException] = None
         for attempt in range(attempts):
@@ -555,7 +564,7 @@ class CoreWorker:
                      max_restarts=0, name=None, namespace="default",
                      get_if_exists=False, detached=False, max_concurrency=1,
                      scheduling=None) -> str:
-        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         creation_spec = cloudpickle.dumps({
             "cls": cloudpickle.dumps(cls),
             "args": s_args,
@@ -577,6 +586,12 @@ class CoreWorker:
             "get_if_exists": get_if_exists,
             "scheduling": scheduling or {},
         }))
+        if pinned_args:
+            # Creation args stay pinned for the actor's lifetime: the GCS
+            # may replay the creation spec on restart at any point.
+            if not hasattr(self, "_actor_creation_pins"):
+                self._actor_creation_pins = {}
+            self._actor_creation_pins[reply["actor_id"]] = pinned_args
         return reply["actor_id"]
 
     def _actor(self, actor_id_hex: str) -> dict:
@@ -590,7 +605,7 @@ class CoreWorker:
     def submit_actor_task(self, actor_id_hex: str, method: str, args, kwargs,
                           *, num_returns=1) -> List[ObjectRef]:
         task_id = task_id_generator.next()
-        s_args, s_kwargs = self.serialize_args(args, kwargs)
+        s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(num_returns)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
@@ -606,11 +621,12 @@ class CoreWorker:
             "owner_address": self.address,
         }
         asyncio.run_coroutine_threadsafe(
-            self._submit_actor_call(actor_id_hex, call, return_ids), self.loop)
+            self._submit_actor_call(actor_id_hex, call, return_ids,
+                                    pinned_args=pinned_args), self.loop)
         return refs
 
     async def _submit_actor_call(self, actor_id_hex, call, return_ids,
-                                 _retry: int = 0):
+                                 _retry: int = 0, pinned_args=None):
         st = self._actor(actor_id_hex)
         try:
             conn = await self._actor_conn(actor_id_hex, st)

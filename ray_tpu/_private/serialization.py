@@ -138,9 +138,14 @@ def _register_jax_reducers():
 
         import copyreg
         copyreg.pickle(jax.Array, _reduce_jax_array)
-        # Concrete array classes are registered dynamically; cloudpickle
-        # dispatches on exact type, so register the common concrete type too.
-        concrete = type(jax.numpy.zeros((), dtype=jax.numpy.float32))
-        copyreg.pickle(concrete, _reduce_jax_array)
+        # Concrete array class: resolve it WITHOUT creating an array --
+        # materializing even a scalar would initialize the default backend
+        # (on a TPU host that grabs/blocks on the chip) in every process
+        # that merely serializes data.
+        try:
+            from jax._src.array import ArrayImpl
+            copyreg.pickle(ArrayImpl, _reduce_jax_array)
+        except Exception:
+            pass
     except Exception:  # jax not importable in some tool contexts
         pass

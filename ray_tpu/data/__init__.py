@@ -1,0 +1,30 @@
+"""Distributed datasets (Ray Data equivalent).
+
+Design analog: reference ``python/ray/data/`` -- Dataset (dataset.py:146),
+blocks as objects in the shared store (block.py), lazy-free eager stage
+execution as remote tasks (_internal/compute.py TaskPoolStrategy /
+ActorPoolStrategy), read_api.py datasources, DatasetPipeline
+(dataset_pipeline.py:64).  TPU-first: ``iter_batches`` yields host numpy
+ready for device put, and ``split`` aligns shards with a train worker gang.
+"""
+
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.read_api import (
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A001 - mirrors reference API name
+    range_tensor,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "ActorPoolStrategy", "Dataset", "DatasetPipeline",
+    "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
+    "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",
+]

@@ -1,0 +1,109 @@
+"""Blocks: the unit of distributed data.
+
+Design analog: reference ``python/ray/data/block.py`` (Block = Arrow table /
+pandas / simple list partition, BlockMetadata, BlockAccessor).  A block here
+is a list of rows (dicts or scalars) or a dict of numpy column arrays;
+BlockAccessor normalizes between formats.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[Any] = None
+
+    @staticmethod
+    def for_block(block) -> "BlockMetadata":
+        acc = BlockAccessor(block)
+        return BlockMetadata(num_rows=acc.num_rows(),
+                             size_bytes=acc.size_bytes(),
+                             schema=acc.schema())
+
+
+class BlockAccessor:
+    """Uniform view over list-blocks and column-dict (tensor) blocks."""
+
+    def __init__(self, block):
+        self._block = block
+        self._is_columnar = isinstance(block, dict)
+
+    def num_rows(self) -> int:
+        if self._is_columnar:
+            if not self._block:
+                return 0
+            return len(next(iter(self._block.values())))
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if self._is_columnar:
+            return int(sum(np.asarray(v).nbytes
+                           for v in self._block.values()))
+        try:
+            import sys
+            return sum(sys.getsizeof(r) for r in self._block[:64]) * \
+                max(1, len(self._block) // max(1, len(self._block[:64])))
+        except Exception:
+            return 0
+
+    def schema(self):
+        if self._is_columnar:
+            return {k: str(np.asarray(v).dtype)
+                    for k, v in self._block.items()}
+        if self._block and isinstance(self._block[0], dict):
+            return sorted(self._block[0].keys())
+        return type(self._block[0]).__name__ if self._block else None
+
+    def rows(self) -> List[Any]:
+        if self._is_columnar:
+            keys = list(self._block.keys())
+            n = self.num_rows()
+            return [{k: self._block[k][i] for k in keys}
+                    for i in range(n)]
+        return list(self._block)
+
+    def slice(self, start: int, end: int):
+        if self._is_columnar:
+            return {k: v[start:end] for k, v in self._block.items()}
+        return self._block[start:end]
+
+    def to_numpy_batch(self) -> Dict[str, np.ndarray]:
+        """Batch form handed to map_batches(batch_format='numpy')."""
+        if self._is_columnar:
+            return {k: np.asarray(v) for k, v in self._block.items()}
+        if self._block and isinstance(self._block[0], dict):
+            keys = self._block[0].keys()
+            return {k: np.asarray([r[k] for r in self._block])
+                    for k in keys}
+        return {"value": np.asarray(self._block)}
+
+    def to_pandas(self):
+        import pandas as pd
+        if self._is_columnar:
+            return pd.DataFrame(
+                {k: list(v) for k, v in self._block.items()})
+        if self._block and isinstance(self._block[0], dict):
+            return pd.DataFrame(self._block)
+        return pd.DataFrame({"value": self._block})
+
+
+def batch_to_block(batch) -> Any:
+    """Normalize a map_batches return value into a block."""
+    import pandas as pd
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    if isinstance(batch, pd.DataFrame):
+        return {c: batch[c].to_numpy() for c in batch.columns}
+    if isinstance(batch, np.ndarray):
+        return {"value": batch}
+    if isinstance(batch, list):
+        return batch
+    raise TypeError(f"map_batches fn returned unsupported type "
+                    f"{type(batch)} (want dict/ndarray/DataFrame/list)")

@@ -68,7 +68,8 @@ class TrialRunner:
                  max_failures: int = 0,
                  experiment_name: str = "exp",
                  storage_path: Optional[str] = None,
-                 checkpoint_period: int = 10):
+                 checkpoint_period: int = 10,
+                 reuse_actors: bool = False):
         self._trainable_cls = trainable_cls
         self._trainable_blob = cloudpickle.dumps(trainable_cls)
         self._searcher = searcher
@@ -82,6 +83,7 @@ class TrialRunner:
         self._experiment_name = experiment_name
         self._storage_path = storage_path
         self._checkpoint_period = checkpoint_period
+        self._reuse_actors = reuse_actors
         self.trials: List[Trial] = []
         self._exploit_requests: List[Tuple[Trial, Trial, Dict]] = []
         self._searcher_exhausted = False
@@ -126,8 +128,13 @@ class TrialRunner:
             tid = f"{len(self.trials):05d}"
             cfg = self._searcher.suggest(tid)
             if cfg is None:
+                # Exhausted vs. backpressured: a searcher with a known
+                # budget is done once it's met; any searcher is done when
+                # it returns None with no trials still in flight (custom
+                # Searchers need not implement total_suggestions).
                 total = self._searcher.total_suggestions
-                if total is not None and len(self.trials) >= total:
+                if (total is not None and len(self.trials) >= total) or \
+                        all(t.is_finished() for t in self.trials):
                     self._searcher_exhausted = True
                 break
             trial = Trial(cfg, trial_id=tid,
@@ -174,7 +181,13 @@ class TrialRunner:
             return
         ready_set, _ = ray_tpu.wait(refs, num_returns=len(refs),
                                     timeout=0.05)
-        for ref in (ready_set or ready):
+        batch = ready_set or ready
+        # Rotate processing order each step: lockstep trials otherwise hit
+        # every ASHA rung in trial order, and the first arrival at an empty
+        # rung always survives -- rotation restores the asynchrony the
+        # schedulers assume.
+        rot = self._steps % len(batch)
+        for ref in batch[rot:] + batch[:rot]:
             self._handle_result_ref(ref)
 
     def _handle_result_ref(self, ref):
@@ -211,16 +224,11 @@ class TrialRunner:
             trial.pending_ref = trial.actor.train.remote()
 
     def _should_stop(self, result: Dict[str, Any]) -> bool:
-        for key, threshold in self._stop.items():
-            if key in result:
-                if key == "training_iteration":
-                    if result[key] >= threshold:
-                        return True
-                elif self._mode == "max" and result[key] >= threshold:
-                    return True
-                elif self._mode == "min" and result[key] <= threshold:
-                    return True
-        return False
+        # Reference semantics (tune/stopper MaximumIterationStopper et al.):
+        # stop when result[key] >= threshold, regardless of optimization
+        # mode -- thresholds are ceilings on monotone counters/metrics.
+        return any(key in result and result[key] >= threshold
+                   for key, threshold in self._stop.items())
 
     def _checkpoint_trial(self, trial: Trial):
         try:
@@ -279,8 +287,21 @@ class TrialRunner:
                     ray_tpu.get(victim.pending_ref)
             except Exception:
                 pass
-            self._stop_actor(victim)
+            victim.pending_ref = None
             victim.config = new_config
+            if self._reuse_actors and victim.actor is not None:
+                # In-place exploit: reset_config + restore on the live
+                # actor (reference reuse_actors fast path).
+                try:
+                    if ray_tpu.get(
+                            victim.actor.reset.remote(new_config)):
+                        ray_tpu.get(
+                            victim.actor.restore.remote(donor_ckpt))
+                        victim.pending_ref = victim.actor.train.remote()
+                        continue
+                except Exception:
+                    pass
+            self._stop_actor(victim)
             victim.status = PENDING
             self._start_trial(victim, restore_from=donor_ckpt)
 

@@ -78,6 +78,7 @@ class BasicVariantGenerator(Searcher):
         if config:
             self._space = config
         self._variants = None
+        self._idx = 0
         return super().set_search_properties(metric, mode, config)
 
     def _materialize(self):

@@ -48,8 +48,14 @@ class Tuner:
         self._restore_path = _restore_path
 
     @classmethod
-    def restore(cls, path: str, trainable) -> "Tuner":
-        return cls(trainable, _restore_path=path)
+    def restore(cls, path: str, trainable,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory.
+        Pass the original tune_config/run_config so stop criteria and
+        schedulers apply to the resumed trials as well."""
+        return cls(trainable, tune_config=tune_config,
+                   run_config=run_config, _restore_path=path)
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -65,6 +71,9 @@ class Tuner:
         storage = self._run_config.storage_path
         if storage:
             storage = os.path.join(storage, name)
+        elif self._restore_path:
+            # Resumed experiments keep checkpointing where they left off.
+            storage = self._restore_path
 
         runner = TrialRunner(
             self._trainable_cls,
@@ -77,6 +86,7 @@ class Tuner:
             max_failures=self._run_config.failure_config.max_failures,
             experiment_name=name,
             storage_path=storage,
+            reuse_actors=tc.reuse_actors,
         )
         if self._restore_path:
             runner.restore_experiment_state(self._restore_path)

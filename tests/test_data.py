@@ -1,0 +1,207 @@
+"""Data layer tests.
+
+Reference shape: python/ray/data/tests/test_dataset.py (range/from_items,
+map/map_batches/filter, repartition, split for Train ingest, shuffle,
+sort, zip, iter_batches, file IO round trips, pipeline windows).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_and_count(ray_start):
+    ds = rd.range(100, parallelism=8)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 8
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_from_items_map_filter(ray_start):
+    ds = rd.from_items(list(range(20)), parallelism=4)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    vals = sorted(out.take_all())
+    assert vals == [x * 2 for x in range(20) if (x * 2) % 4 == 0]
+
+
+def test_flat_map(ray_start):
+    ds = rd.from_items([1, 2, 3], parallelism=2)
+    assert sorted(ds.flat_map(lambda x: [x, x]).take_all()) == \
+        [1, 1, 2, 2, 3, 3]
+
+
+def test_map_batches_numpy(ray_start):
+    ds = rd.from_numpy(np.arange(32, dtype=np.float32), parallelism=4)
+
+    def double(batch):
+        return {"data": batch["data"] * 2}
+
+    out = ds.map_batches(double, batch_size=8, batch_format="numpy")
+    got = np.sort(np.concatenate(
+        [np.atleast_1d(np.asarray(r["data"])) for r in out.take_all()]))
+    np.testing.assert_array_equal(got, np.arange(32, dtype=np.float32) * 2)
+
+
+def test_map_batches_pandas(ray_start):
+    import pandas as pd
+    df = pd.DataFrame({"a": range(10), "b": range(10)})
+    ds = rd.from_pandas(df, parallelism=2)
+
+    def add_col(batch):
+        batch["c"] = batch["a"] + batch["b"]
+        return batch
+
+    out = ds.map_batches(add_col, batch_format="pandas")
+    res = out.to_pandas().sort_values("a").reset_index(drop=True)
+    assert (res["c"] == res["a"] + res["b"]).all()
+
+
+def test_repartition_and_split(ray_start):
+    ds = rd.range(100, parallelism=7)
+    r = ds.repartition(4)
+    assert r.num_blocks() == 4
+    counts = [m.num_rows for m in r._meta()]
+    assert sorted(counts) == [25, 25, 25, 25]
+    assert sorted(r.take_all()) == list(range(100))
+
+    shards = ds.split(4, equal=True)
+    assert len(shards) == 4
+    assert all(s.count() == 25 for s in shards)
+    combined = sorted(sum((s.take_all() for s in shards), []))
+    assert combined == list(range(100))
+
+
+def test_random_shuffle(ray_start):
+    ds = rd.range(50, parallelism=5)
+    shuffled = ds.random_shuffle(seed=42)
+    vals = shuffled.take_all()
+    assert sorted(vals) == list(range(50))
+    assert vals != list(range(50))
+
+
+def test_sort(ray_start):
+    import random
+    items = list(range(40))
+    random.Random(0).shuffle(items)
+    ds = rd.from_items(items, parallelism=4)
+    assert ds.sort().take_all() == list(range(40))
+    assert ds.sort(descending=True).take_all() == list(range(39, -1, -1))
+
+    recs = rd.from_items([{"k": i % 5, "v": i} for i in range(20)],
+                         parallelism=3)
+    out = recs.sort(key="k").take_all()
+    assert [r["k"] for r in out] == sorted(i % 5 for i in range(20))
+
+
+def test_zip_union_limit(ray_start):
+    a = rd.from_items([{"x": i} for i in range(10)], parallelism=2)
+    b = rd.from_items([{"y": i * 10} for i in range(10)], parallelism=2)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["y"] == r["x"] * 10 for r in rows)
+
+    u = a.union(a)
+    assert u.count() == 20
+    assert a.limit(3).count() == 3
+
+
+def test_aggregates(ray_start):
+    ds = rd.range(10, parallelism=3)
+    assert ds.sum() == 45
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert ds.mean() == pytest.approx(4.5)
+    recs = rd.from_items([{"v": float(i)} for i in range(5)], parallelism=2)
+    assert recs.sum(on="v") == 10.0
+
+
+def test_iter_batches_sizes(ray_start):
+    ds = rd.range(25, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=10, batch_format="numpy"))
+    sizes = [len(b["value"]) for b in batches]
+    assert sum(sizes) == 25
+    assert sizes[:-1] == [10, 10]
+    # drop_last drops the remainder batch
+    batches = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert sum(len(b["value"]) for b in batches) == 20
+
+
+def test_file_roundtrips(ray_start, tmp_path):
+    import pandas as pd
+    df = pd.DataFrame({"a": range(12), "b": [f"s{i}" for i in range(12)]})
+    ds = rd.from_pandas(df, parallelism=3)
+
+    pq_dir = str(tmp_path / "pq")
+    ds.write_parquet(pq_dir)
+    back = rd.read_parquet(pq_dir)
+    assert back.count() == 12
+    assert sorted(back.to_pandas()["a"].tolist()) == list(range(12))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert rd.read_csv(csv_dir).count() == 12
+
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    assert rd.read_json(json_dir).count() == 12
+
+
+def test_actor_pool_strategy(ray_start):
+    ds = rd.range(16, parallelism=4)
+    out = ds.map_batches(lambda b: {"value": b["value"] + 1},
+                        compute=rd.ActorPoolStrategy(size=2))
+    assert sorted(np.concatenate(
+        [np.atleast_1d(np.asarray(r["value"])) for r in out.take_all()]
+    ).tolist()) == list(range(1, 17))
+
+
+def test_pipeline_windows_and_repeat(ray_start):
+    ds = rd.range(12, parallelism=4)
+    pipe = ds.window(blocks_per_window=2).map(lambda x: x + 1)
+    vals = sorted(pipe.take(12))
+    assert vals == list(range(1, 13))
+
+    pipe2 = ds.repeat(2)
+    assert len(list(pipe2.iter_rows())) == 24
+
+
+def test_train_ingest_integration(ray_start):
+    """Dataset -> JaxTrainer sharding (reference: Train DatasetSpec)."""
+    from ray_tpu.air import ScalingConfig, session
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    def loop(config):
+        from ray_tpu.train.data_parallel_trainer import get_dataset_shard
+        shard = get_dataset_shard("train")
+        total = 0
+        n = 0
+        for batch in shard.iter_batches(batch_size=8):
+            total += float(np.sum(batch["value"]))
+            n += len(batch["value"])
+        session.report({"total": total, "n": n})
+
+    ds = rd.range(64, parallelism=8)
+    trainer = JaxTrainer(
+        loop,
+        jax_config=JaxConfig(distributed=False),
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.metrics["n"] == 32
+
+
+def test_zip_misaligned_blocks(ray_start):
+    a = rd.from_items([{"x": i} for i in range(4)], parallelism=1)
+    a = rd.Dataset(a._blocks)  # 1 block of 4
+    b1 = rd.from_items([{"y": i * 10} for i in range(2)], parallelism=1)
+    b2 = rd.from_items([{"y": (i + 2) * 10} for i in range(2)], parallelism=1)
+    b = b1.union(b2)  # 2 blocks of 2 (different layout, same total)
+    rows = a.zip(b).take_all()
+    assert all(r["y"] == r["x"] * 10 for r in rows)
+
+    c = rd.from_items([{"y": 0}] * 3, parallelism=1)
+    with pytest.raises(Exception):
+        a.zip(c).take_all()
