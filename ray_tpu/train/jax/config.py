@@ -39,6 +39,11 @@ def _init_jax_distributed(coordinator: str, num_processes: int,
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
     import jax
+    if platform:
+        # A sitecustomize-injected TPU plugin may have pinned jax_platforms
+        # at interpreter start; config.update wins as long as no backend has
+        # been initialized yet (workers call this before any jax use).
+        jax.config.update("jax_platforms", platform)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

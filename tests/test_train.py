@@ -2,8 +2,9 @@
 
 Reference shape: python/ray/train/tests/test_data_parallel_trainer.py
 (fit reports metrics, ranks assigned, checkpoint restore, failure recovery).
-Workers run single-process JAX on CPU (distributed=False) -- the
-jax.distributed path is exercised by the driver's multichip dryrun.
+Workers here run single-process JAX on CPU (distributed=False); the real
+multi-process jax.distributed path is exercised by
+tests/test_train_distributed.py.
 """
 
 import pytest

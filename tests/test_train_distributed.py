@@ -1,0 +1,132 @@
+"""Multi-process jax.distributed training tests.
+
+The TPU analog of the reference's ``_setup_torch_process_group`` test
+surface (reference python/ray/train/torch/config.py:69-113): JaxTrainer
+launches 2 real OS worker processes, ``JaxConfig(distributed=True)`` runs
+``jax.distributed.initialize`` in each, and a shard_map psum runs ACROSS
+process boundaries (XLA CPU collectives over Gloo), proving the gang is one
+multi-controller JAX program.
+"""
+
+import pytest
+
+from ray_tpu.air import ScalingConfig, session
+from ray_tpu.train import JaxConfig, JaxTrainer
+
+
+def _loop_psum(config):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nproc = jax.process_count()
+    local = jax.local_device_count()
+    total = jax.device_count()
+    assert total == nproc * local, (total, nproc, local)
+
+    mesh = jax.make_mesh((total,), ("dp",))
+    # Each process contributes its rank to every local shard; the psum runs
+    # across process boundaries.
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        jnp.full((local,), float(jax.process_index())))
+    y = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "dp"),
+                              mesh=mesh, in_specs=P("dp"), out_specs=P()))(x)
+    session.report({
+        "psum": float(y[0]),
+        "num_processes": nproc,
+        "global_devices": total,
+        "local_devices": local,
+        "rank": session.get_world_rank(),
+    })
+
+
+def test_jax_distributed_two_processes(ray_start_fresh):
+    trainer = JaxTrainer(
+        _loop_psum,
+        jax_config=JaxConfig(distributed=True, platform="cpu"),
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    m = result.metrics
+    assert m["num_processes"] == 2
+    assert m["global_devices"] == 2 * m["local_devices"]
+    # sum over devices of per-process rank value: ranks 0 and 1 each
+    # contribute `local` shards -> psum == local * (0 + 1).
+    assert m["psum"] == pytest.approx(m["local_devices"] * 1.0)
+
+
+def _loop_allreduce_train(config):
+    """A real data-parallel step: per-process batches, grads psummed across
+    processes inside jit -- the TPU-native DDP equivalent."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    total = jax.device_count()
+    mesh = jax.make_mesh((total,), ("dp",))
+    rank = jax.process_index()
+    local = jax.local_device_count()
+
+    tx = optax.sgd(0.05)
+    # Multi-controller discipline: carried state must be GLOBAL arrays with
+    # identical (replicated) sharding in every process — process-local
+    # singleton arrays would give each process a different program and
+    # deadlock the Gloo collectives.
+    repl = NamedSharding(mesh, P())
+    w, opt_state = jax.jit(
+        lambda: (jnp.zeros((4,)), tx.init(jnp.zeros((4,)))),
+        out_shardings=repl)()
+
+    key = jax.random.PRNGKey(rank)
+    xs_local = jax.random.normal(key, (local * 8, 4))
+    true_w = jnp.array([1.0, -2.0, 3.0, 0.5])
+    ys_local = xs_local @ true_w
+
+    xs = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), xs_local)
+    ys = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), ys_local)
+
+    from functools import partial
+
+    @partial(jax.jit, out_shardings=(repl, repl, repl))
+    def step(w, opt_state, x, y):
+        # Explicit DDP: the pmean sits INSIDE the differentiated loss, so
+        # the backward pass emits exactly one grad allreduce (the
+        # compiled-in equivalent of torch DDP's NCCL allreduce).  Note:
+        # under shard_map's varying-axes semantics, grads wrt an unvarying
+        # (P()) input are implicitly psummed over the axis — averaging must
+        # happen in the loss, not by pmean-ing the grad afterwards.
+        def sharded(w, x, y):
+            def loss_fn(w):
+                return jax.lax.pmean(jnp.mean((x @ w - y) ** 2), "dp")
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            return loss, g
+        loss, g = jax.shard_map(
+            sharded, mesh=mesh,
+            in_specs=(P(), P("dp"), P("dp")),
+            out_specs=(P(), P()))(w, x, y)
+        updates, opt_state = tx.update(g, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    for _ in range(60):
+        w, opt_state, loss = step(w, opt_state, xs, ys)
+        # Per-step sync: XLA's CPU (Gloo) collectives deadlock when many
+        # async executions pile up cross-process; real TPU (ICI) doesn't
+        # need this.
+        jax.block_until_ready(loss)
+    session.report({"loss": float(loss),
+                    "w_err": float(jnp.max(jnp.abs(w - true_w)))})
+
+
+def test_jax_distributed_data_parallel_training(ray_start_fresh):
+    trainer = JaxTrainer(
+        _loop_allreduce_train,
+        jax_config=JaxConfig(distributed=True, platform="cpu"),
+        scaling_config=ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    assert result.metrics["loss"] < 1e-2
+    assert result.metrics["w_err"] < 0.2
