@@ -28,9 +28,13 @@ import time
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 18.0
 
 # Peak bf16 FLOP/s per chip by TPU generation (public spec sheet numbers).
+# Both marketing names (v5e) and JAX device_kind forms ("TPU v5 lite" ->
+# "tpuv5lite") are keyed; longest match wins so "v5litepod" etc. resolve.
 PEAK_FLOPS = {
     "v2": 45e12, "v3": 123e12, "v4": 275e12,
-    "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+    "v5e": 197e12, "v5lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12, "v6lite": 918e12,
 }
 DEFAULT_PEAK = 275e12  # assume v4-class when the kind string is opaque
 
@@ -72,6 +76,9 @@ def _probe_tpu(retries: int = 2) -> bool:
 
 
 def _run_child(platform: str) -> int:
+    """Run the measurement child; re-emit its stdout (the JSON line) only
+    on rc==0, so a child that prints-then-crashes can't leave a stray line
+    ahead of the fallback's output."""
     if platform == "cpu":
         # Hermetic CPU fallback (shared helper with the multichip dryrun).
         from __graft_entry__ import hermetic_cpu_env
@@ -82,10 +89,17 @@ def _run_child(platform: str) -> int:
         timeout = TPU_CHILD_TIMEOUT_S
     env["RAY_TPU_BENCH_CHILD"] = "1"
     try:
-        return subprocess.call([sys.executable, os.path.abspath(__file__)],
-                               env=env, timeout=timeout)
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=timeout,
+                              stdout=subprocess.PIPE, text=True)
     except subprocess.TimeoutExpired:
         return 124
+    if proc.returncode == 0:
+        sys.stdout.write(proc.stdout)
+        sys.stdout.flush()
+    elif proc.stdout:
+        _log(f"bench: discarding output of failed child: {proc.stdout!r}")
+    return proc.returncode
 
 
 def main() -> None:
@@ -170,9 +184,10 @@ def child_main() -> None:
         tokens_per_sec = samples_per_sec * seq
         kind = str(getattr(devices[0], "device_kind", "") or "")
         peak = DEFAULT_PEAK
+        matched = ""
         for gen, f in PEAK_FLOPS.items():
-            if gen in kind.lower().replace(" ", ""):
-                peak = f
+            if gen in kind.lower().replace(" ", "") and len(gen) > len(matched):
+                peak, matched = f, gen
         result["mfu"] = round(
             flops_per_token * tokens_per_sec / (n * peak), 4)
         result["device_kind"] = kind

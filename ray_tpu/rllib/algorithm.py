@@ -1,0 +1,125 @@
+"""Algorithm: the RL training loop as a Tune Trainable.
+
+Design analog: reference ``rllib/algorithms/algorithm.py:143`` (Algorithm
+is a Trainable whose ``step`` runs ``training_step`` then collects
+metrics) and ``algorithm_config.py:152`` (fluent config builder).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.worker_set import WorkerSet
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent builder: ``PPOConfig().environment("CartPole-v1")
+    .rollouts(num_rollout_workers=2).training(lr=1e-3).build()``."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self._config: Dict[str, Any] = {
+            "env": None,
+            "env_config": {},
+            "num_rollout_workers": 0,
+            "num_envs_per_worker": 1,
+            "rollout_fragment_length": 128,
+            "num_cpus_per_worker": 1,
+            "gamma": 0.99,
+            "lr": 3e-4,
+            "seed": 0,
+            "restore_unhealthy_workers": True,
+            "metrics_num_episodes_for_smoothing": 100,
+        }
+
+    def environment(self, env: str, env_config: Optional[Dict] = None
+                    ) -> "AlgorithmConfig":
+        self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def rollouts(self, **kwargs) -> "AlgorithmConfig":
+        self._config.update(kwargs)
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        self._config.update(kwargs)
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "AlgorithmConfig":
+        self._config["seed"] = seed
+        return self
+
+    def resources(self, **kwargs) -> "AlgorithmConfig":
+        self._config.update(kwargs)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._config)
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class bound")
+        return self.algo_class(config=self.to_dict())
+
+
+class Algorithm(Trainable):
+    """Subclasses implement ``training_step() -> result dict``."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self.workers = WorkerSet(config)
+        self._episode_rewards: collections.deque = collections.deque(
+            maxlen=config.get("metrics_num_episodes_for_smoothing", 100))
+        self._episode_lens: collections.deque = collections.deque(
+            maxlen=config.get("metrics_num_episodes_for_smoothing", 100))
+        self._timesteps_total = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        if self.config.get("restore_unhealthy_workers", True):
+            bad = self.workers.probe_unhealthy_workers()
+            if bad:
+                self.workers.restore_unhealthy_workers(bad)
+        result = self.training_step()
+        m = self.workers.collect_metrics()
+        self._episode_rewards.extend(m["episode_rewards"])
+        self._episode_lens.extend(m["episode_lens"])
+        if self._episode_rewards:
+            result["episode_reward_mean"] = float(
+                np.mean(self._episode_rewards))
+            result["episode_reward_max"] = float(
+                np.max(self._episode_rewards))
+            result["episode_len_mean"] = float(np.mean(self._episode_lens))
+        result["num_env_steps_sampled"] = self._timesteps_total
+        return result
+
+    # -- checkpointing (Trainable contract) -------------------------------
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {"weights": self.workers.local_worker.get_weights(),
+                "timesteps": self._timesteps_total}
+
+    def load_checkpoint(self, checkpoint: Optional[Dict[str, Any]]) -> None:
+        if not checkpoint:
+            return
+        self.workers.local_worker.set_weights(checkpoint["weights"])
+        self._timesteps_total = checkpoint.get("timesteps", 0)
+        self.workers.sync_weights()
+
+    def get_policy(self):
+        return self.workers.local_worker.policy
+
+    def cleanup(self) -> None:
+        self.workers.stop()
+
+    @classmethod
+    def default_resource_request(cls, config: Dict[str, Any]
+                                 ) -> Dict[str, float]:
+        return {"CPU": 1.0 + config.get("num_rollout_workers", 0)
+                * config.get("num_cpus_per_worker", 1)}

@@ -1,0 +1,287 @@
+"""Policies: jax actor-critic networks + the Policy contract.
+
+Design analog: reference ``rllib/policy/policy.py`` + ``torch_policy_v2.py``
+(compute_actions / loss / learn_on_batch / get-set_weights).  TPU-first
+deltas: the network is a pure-jax pytree (no framework Module), action
+sampling is a jitted function driven by a PRNG key, and the PPO update is a
+single jitted program whose minibatch SGD loop lives INSIDE jit
+(lax.scan over epochs x minibatches) so one dispatch per training step
+reaches the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ACTION_LOGP, ADVANTAGES, DONES, OBS, REWARDS, SampleBatch,
+    VALUE_TARGETS, VF_PREDS)
+
+
+# -- actor-critic network (shared tanh trunk, logits + value heads) -------
+
+def _orthogonal(rng, shape, scale):
+    """Orthogonal init (standard for PPO; keeps early policy near-uniform)."""
+    a = jax.random.normal(rng, shape)
+    q, r = jnp.linalg.qr(a if shape[0] >= shape[1] else a.T)
+    q = q * jnp.sign(jnp.diag(r))
+    if shape[0] < shape[1]:
+        q = q.T
+    return scale * q[:shape[0], :shape[1]]
+
+
+def ac_init(rng: jax.Array, obs_dim: int, num_outputs: int,
+            hiddens=(64, 64)) -> Dict:
+    keys = jax.random.split(rng, len(hiddens) + 2)
+    params, sizes = {}, (obs_dim,) + tuple(hiddens)
+    for i in range(len(hiddens)):
+        params[f"trunk{i}"] = {
+            "w": _orthogonal(keys[i], (sizes[i], sizes[i + 1]),
+                             jnp.sqrt(2.0)),
+            "b": jnp.zeros((sizes[i + 1],))}
+    params["pi"] = {"w": _orthogonal(keys[-2], (sizes[-1], num_outputs),
+                                     0.01),
+                    "b": jnp.zeros((num_outputs,))}
+    params["vf"] = {"w": _orthogonal(keys[-1], (sizes[-1], 1), 1.0),
+                    "b": jnp.zeros((1,))}
+    return params
+
+
+def ac_forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """-> (pi_out [B, num_outputs], value [B])."""
+    x = obs
+    i = 0
+    while f"trunk{i}" in params:
+        p = params[f"trunk{i}"]
+        x = jnp.tanh(x @ p["w"] + p["b"])
+        i += 1
+    pi = x @ params["pi"]["w"] + params["pi"]["b"]
+    v = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return pi, v
+
+
+# -- distributions --------------------------------------------------------
+
+class Categorical:
+    """Discrete action head over logits."""
+
+    @staticmethod
+    def sample(rng, logits):
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    @staticmethod
+    def logp(logits, actions):
+        return jnp.take_along_axis(
+            jax.nn.log_softmax(logits), actions[:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class DiagGaussian:
+    """Continuous action head: first half means, second half log-stds."""
+
+    @staticmethod
+    def split(out):
+        d = out.shape[-1] // 2
+        return out[..., :d], jnp.clip(out[..., d:], -5.0, 2.0)
+
+    @staticmethod
+    def sample(rng, out):
+        mean, log_std = DiagGaussian.split(out)
+        return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    @staticmethod
+    def logp(out, actions):
+        mean, log_std = DiagGaussian.split(out)
+        var = jnp.exp(2 * log_std)
+        ll = -0.5 * ((actions - mean) ** 2 / var
+                     + 2 * log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    @staticmethod
+    def entropy(out):
+        _, log_std = DiagGaussian.split(out)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+
+# -- GAE ------------------------------------------------------------------
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+                last_values: np.ndarray, gamma: float, lam: float
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Generalized advantage estimation over [T, N] rollout arrays.
+
+    ``dones`` cuts bootstrapping at episode ends; ``last_values`` bootstraps
+    the final step.  Host-side numpy (T is small; the learner is the TPU
+    program, not this scan).  Reference analog:
+    rllib/evaluation/postprocessing.py compute_gae_for_sample_batch.
+    """
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    lastgaelam = np.zeros_like(last_values)
+    nextvalues = last_values
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t].astype(rewards.dtype)
+        delta = rewards[t] + gamma * nextvalues * nonterminal - values[t]
+        lastgaelam = delta + gamma * lam * nonterminal * lastgaelam
+        adv[t] = lastgaelam
+        nextvalues = values[t]
+    return adv, adv + values
+
+
+# -- Policy ---------------------------------------------------------------
+
+class Policy:
+    """Contract the rollout worker and learner drive."""
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights):
+        raise NotImplementedError
+
+
+class PPOPolicy(Policy):
+    """Actor-critic PPO policy over a jax pytree.
+
+    The minibatch-SGD update is one jitted program (``_update``): epochs x
+    minibatches scanned with lax.scan, clipped-surrogate + value + entropy
+    loss.  On a multi-device mesh the caller shards the train batch along
+    the leading axis; grads reduce via the mesh's compiled collectives.
+    """
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        self.config = config
+        self.discrete = action_space.kind == "discrete"
+        self.dist = Categorical if self.discrete else DiagGaussian
+        num_outputs = (action_space.n if self.discrete
+                       else 2 * int(np.prod(action_space.shape)))
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = ac_init(init_rng, obs_dim, num_outputs,
+                              tuple(config.get("hiddens", (64, 64))))
+        import optax
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
+            optax.adam(config.get("lr", 3e-4)))
+        self.opt_state = self._tx.init(self.params)
+
+        dist = self.dist
+
+        @jax.jit
+        def _act(params, rng, obs):
+            pi, v = ac_forward(params, obs)
+            actions = dist.sample(rng, pi)
+            return actions, dist.logp(pi, actions), v
+        self._act = _act
+
+        clip = config.get("clip_param", 0.2)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+        vf_clip = config.get("vf_clip_param", 10.0)
+
+        def _loss(params, mb):
+            pi, v = ac_forward(params, mb[OBS])
+            logp = dist.logp(pi, mb[ACTIONS])
+            ratio = jnp.exp(logp - mb[ACTION_LOGP])
+            adv = mb[ADVANTAGES]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            # Pessimistic vf clip: MAX of unclipped / clipped squared error
+            # (min would zero the gradient exactly when v drifts furthest).
+            vf_err = jnp.maximum((v - mb[VALUE_TARGETS]) ** 2,
+                                 (mb[VF_PREDS]
+                                  + jnp.clip(v - mb[VF_PREDS],
+                                             -vf_clip, vf_clip)
+                                  - mb[VALUE_TARGETS]) ** 2)
+            entropy = dist.entropy(pi)
+            total = (-jnp.mean(surr) + vf_coeff * jnp.mean(vf_err)
+                     - ent_coeff * jnp.mean(entropy))
+            stats = {"policy_loss": -jnp.mean(surr),
+                     "vf_loss": jnp.mean(vf_err),
+                     "entropy": jnp.mean(entropy),
+                     "total_loss": total,
+                     "approx_kl": jnp.mean(mb[ACTION_LOGP] - logp)}
+            return total, stats
+
+        num_epochs = config.get("num_sgd_iter", 4)
+        mb_size = config.get("sgd_minibatch_size", 128)
+
+        @jax.jit
+        def _update(params, opt_state, rng, batch):
+            n = batch[OBS].shape[0]
+            mb = min(mb_size, n)  # small batches become one minibatch
+            num_mb = n // mb
+
+            def epoch_body(carry, epoch_rng):
+                params, opt_state = carry
+                perm = jax.random.permutation(epoch_rng, n)
+                shuffled = {k: v[perm] for k, v in batch.items()}
+                mbs = {k: v[: num_mb * mb].reshape(
+                           (num_mb, mb) + v.shape[1:])
+                       for k, v in shuffled.items()}
+
+                def mb_body(carry, mb):
+                    params, opt_state = carry
+                    (_, stats), grads = jax.value_and_grad(
+                        _loss, has_aux=True)(params, mb)
+                    updates, opt_state = self._tx.update(grads, opt_state)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), stats
+
+                (params, opt_state), stats = jax.lax.scan(
+                    mb_body, (params, opt_state), mbs)
+                return (params, opt_state), stats
+
+            epoch_rngs = jax.random.split(rng, num_epochs)
+            (params, opt_state), stats = jax.lax.scan(
+                epoch_body, (params, opt_state), epoch_rngs)
+            last_stats = jax.tree.map(lambda s: s[-1, -1], stats)
+            return params, opt_state, last_stats
+        self._update = _update
+
+    # -- rollout side -----------------------------------------------------
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._rng, rng = jax.random.split(self._rng)
+        actions, logp, v = self._act(self.params, rng,
+                                     jnp.asarray(obs, jnp.float32))
+        return {ACTIONS: np.asarray(actions), ACTION_LOGP: np.asarray(logp),
+                VF_PREDS: np.asarray(v)}
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        _, v = ac_forward(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(v)
+
+    # -- learner side -----------------------------------------------------
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        adv = np.asarray(batch[ADVANTAGES], np.float32)
+        batch = dict(batch)
+        batch[ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        device_batch = {
+            k: jnp.asarray(np.asarray(v, np.float32 if k != ACTIONS
+                                      else None))
+            for k, v in batch.items()}
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, rng, device_batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)

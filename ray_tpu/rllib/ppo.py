@@ -1,0 +1,44 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Design analog: reference ``rllib/algorithms/ppo/ppo.py:333``
+(``training_step``: synchronous parallel sampling -> minibatch SGD ->
+weight broadcast).  TPU-first deltas: the whole SGD phase (epochs x
+minibatches) is ONE jitted program on the learner (lax.scan, see
+PPOPolicy._update); rollout workers are host-CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PPO)
+        self._config.update({
+            "lambda": 0.95,
+            "clip_param": 0.2,
+            "vf_clip_param": 10.0,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "num_sgd_iter": 4,
+            "sgd_minibatch_size": 128,
+            "grad_clip": 0.5,
+            "lr": 3e-4,
+            "hiddens": (64, 64),
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 128,
+        })
+
+
+class PPO(Algorithm):
+    def training_step(self) -> Dict[str, Any]:
+        train_batch = self.workers.synchronous_sample()
+        self._timesteps_total += train_batch.count
+        stats = self.workers.local_worker.policy.learn_on_batch(train_batch)
+        self.workers.sync_weights()
+        return {"info": {"learner": stats},
+                "train_batch_size": train_batch.count,
+                **{f"learner_{k}": v for k, v in stats.items()}}

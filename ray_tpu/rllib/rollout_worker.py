@@ -1,0 +1,125 @@
+"""RolloutWorker: owns a vector env + policy copy, produces SampleBatches.
+
+Design analog: reference ``rllib/evaluation/rollout_worker.py:165`` (env
+loop, ``sample():875``) with postprocessing (GAE) applied worker-side as in
+``rllib/evaluation/postprocessing.py``.  TPU-first shape: rollout workers
+are host-CPU actors feeding a device learner (Podracer/Anakin split) — the
+env batch steps vectorized in numpy, action selection is one jitted call
+per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.policy import PPOPolicy, compute_gae
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, ACTION_LOGP, ADVANTAGES, DONES, OBS, REWARDS, SampleBatch,
+    VALUE_TARGETS, VF_PREDS)
+
+
+class RolloutWorker:
+    """One sampling unit: ``sample()`` returns a postprocessed SampleBatch
+    of ``rollout_fragment_length * num_envs`` steps."""
+
+    def __init__(self, config: Dict[str, Any], worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        seed = config.get("seed", 0) * 1000 + worker_index
+        self.env = make_vector_env(
+            config["env"], config.get("num_envs_per_worker", 1), seed=seed,
+            **config.get("env_config", {}))
+        obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.policy = PPOPolicy(obs_dim, self.env.action_space, config,
+                                seed=seed)
+        self._obs = self.env.vector_reset(seed=seed)
+        n = self.env.num_envs
+        self._episode_rewards = np.zeros((n,), np.float64)
+        self._episode_lens = np.zeros((n,), np.int64)
+        self._completed_rewards: List[float] = []
+        self._completed_lens: List[int] = []
+
+    # -- sampling ---------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        T = self.config.get("rollout_fragment_length", 128)
+        n = self.env.num_envs
+        gamma = self.config.get("gamma", 0.99)
+        lam = self.config.get("lambda", 0.95)
+
+        obs_buf = np.empty((T, n) + self._obs.shape[1:], np.float32)
+        act_buf: Optional[np.ndarray] = None
+        logp_buf = np.empty((T, n), np.float32)
+        vf_buf = np.empty((T, n), np.float32)
+        rew_buf = np.empty((T, n), np.float32)
+        done_buf = np.empty((T, n), bool)
+        # value bootstrap for envs truncated (not terminated) at step t
+        trunc_bootstrap = np.zeros((T, n), np.float32)
+
+        for t in range(T):
+            out = self.policy.compute_actions(self._obs)
+            actions = out[ACTIONS]
+            if act_buf is None:
+                act_buf = np.empty((T,) + actions.shape, actions.dtype)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = out[ACTION_LOGP]
+            vf_buf[t] = out[VF_PREDS]
+            next_obs, reward, done, info = self.env.vector_step(actions)
+            rew_buf[t] = reward
+            done_buf[t] = done
+            # Truncated episodes still have value beyond the horizon:
+            # bootstrap their reward with V(terminal_obs)
+            # (reference postprocessing.py does the same for TimeLimit).
+            truncated = info.get("truncated")
+            if truncated is not None and truncated.any():
+                term_v = self.policy.compute_values(info["terminal_obs"])
+                trunc_bootstrap[t] = np.where(truncated, term_v, 0.0)
+            self._episode_rewards += reward
+            self._episode_lens += 1
+            if done.any():
+                idx = np.nonzero(done)[0]
+                self._completed_rewards.extend(
+                    self._episode_rewards[idx].tolist())
+                self._completed_lens.extend(self._episode_lens[idx].tolist())
+                self._episode_rewards[idx] = 0.0
+                self._episode_lens[idx] = 0
+            self._obs = next_obs
+
+        rew_buf = rew_buf + gamma * trunc_bootstrap
+        last_values = self.policy.compute_values(self._obs)
+        adv, targets = compute_gae(rew_buf, vf_buf, done_buf, last_values,
+                                   gamma, lam)
+
+        def flat(a):
+            return a.reshape((T * n,) + a.shape[2:])
+        return SampleBatch({
+            OBS: flat(obs_buf), ACTIONS: flat(act_buf),
+            ACTION_LOGP: flat(logp_buf), VF_PREDS: flat(vf_buf),
+            REWARDS: flat(rew_buf), DONES: flat(done_buf),
+            ADVANTAGES: flat(adv), VALUE_TARGETS: flat(targets)})
+
+    # -- weights / metrics / health --------------------------------------
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        """Drain completed-episode stats since the last call."""
+        out = {"episode_rewards": self._completed_rewards,
+               "episode_lens": self._completed_lens}
+        self._completed_rewards = []
+        self._completed_lens = []
+        return out
+
+    def ping(self) -> str:
+        return "ok"
+
+    def apply(self, fn, *args):
+        """Run an arbitrary function on this worker (reference
+        rollout_worker.apply) — used by tests and custom algorithms."""
+        return fn(self, *args)
