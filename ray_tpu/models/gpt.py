@@ -213,10 +213,18 @@ def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
 
 
 def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
-             rules: Optional[LogicalAxisRules] = None, mesh=None) -> jax.Array:
-    """Next-token cross-entropy. batch: {"tokens": [B, S+1] int32}."""
+             rules: Optional[LogicalAxisRules] = None, mesh=None,
+             forward_fn: Optional[Callable] = None) -> jax.Array:
+    """Next-token cross-entropy. batch: {"tokens": [B, S+1] int32}.
+
+    `forward_fn(params, tokens) -> logits` overrides the forward pass (the
+    pipelined variant in `ray_tpu.parallel.pipeline` plugs in here, so loss
+    changes apply to every execution mode at once)."""
     toks = batch["tokens"]
-    logits = gpt_forward(params, toks[:, :-1], cfg, rules, mesh)
+    if forward_fn is None:
+        logits = gpt_forward(params, toks[:, :-1], cfg, rules, mesh)
+    else:
+        logits = forward_fn(params, toks[:, :-1])
     targets = toks[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -237,7 +245,8 @@ def make_train_state(rng, cfg: GPTConfig, learning_rate: float = 3e-4,
 
 def make_train_step(cfg: GPTConfig, tx,
                     rules: Optional[LogicalAxisRules] = None,
-                    mesh=None, donate: bool = True):
+                    mesh=None, donate: bool = True,
+                    forward_fn: Optional[Callable] = None):
     """Returns jittable (params, opt_state, batch) -> (params, opt_state,
     metrics).  Under a Mesh + sharded inputs, XLA emits all collectives
     (gradient reduction across dp/fsdp, tp/sp activation collectives) — the
@@ -245,7 +254,7 @@ def make_train_step(cfg: GPTConfig, tx,
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(gpt_loss)(params, batch, cfg, rules,
-                                                   mesh)
+                                                   mesh, forward_fn)
         updates, opt_state = tx.update(grads, opt_state, params)
         import optax
         params = optax.apply_updates(params, updates)
