@@ -629,11 +629,18 @@ class CoreWorker:
                                  _retry: int = 0, pinned_args=None):
         st = self._actor(actor_id_hex)
         try:
+            logger.debug("actor call %s.%s: resolving conn",
+                         actor_id_hex[:8], call["method"])
             conn = await self._actor_conn(actor_id_hex, st)
             call = dict(call)
             call["seq"] = st["seq"]
             st["seq"] += 1
+            logger.debug("actor call %s.%s seq=%s: sending",
+                         actor_id_hex[:8], call["method"], call["seq"])
             reply = await conn.request(call, timeout=None)
+            logger.debug("actor call %s.%s seq=%s: reply ok=%s",
+                         actor_id_hex[:8], call["method"], call["seq"],
+                         reply.get("ok"))
             if reply.get("ok"):
                 await self._store_task_returns(reply, return_ids)
             else:

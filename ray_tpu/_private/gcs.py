@@ -81,6 +81,7 @@ class ActorInfo:
     death_cause: Optional[str] = None
     scheduling: dict = field(default_factory=dict)
     waiters: List[asyncio.Future] = field(default_factory=list)
+    creation_attempts: int = 0  # spawn-failure retries (not user restarts)
 
     def public(self) -> dict:
         return {
@@ -221,6 +222,15 @@ class GcsServer:
         node.last_heartbeat = time.monotonic()
         if "resources_available" in msg:
             node.resources_available = msg["resources_available"]
+        # Retry queued actors: availability may have just been freed (a
+        # worker died / finished).  Without this, an actor that queued
+        # during a transient full-node view waits for a *new node
+        # registration* that never comes on a static cluster.  Fire and
+        # forget: blocking the heartbeat reply on actor creation would
+        # stall the raylet's heartbeat loop past the health timeout.
+        if self._pending_actor_queue:
+            asyncio.get_running_loop().create_task(
+                self._try_schedule_pending())
         return {"ok": True}
 
     async def _h_get_nodes(self, conn, msg):
@@ -304,6 +314,7 @@ class GcsServer:
             scheduling=msg.get("scheduling", {}),
         )
         self.actors[actor_id] = actor
+        logger.debug("create_actor %s: scheduling", actor_id)
         asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         return {"ok": True, "existing": False, "actor_id": actor_id.hex()}
 
@@ -350,8 +361,11 @@ class GcsServer:
     async def _schedule_actor(self, actor: ActorInfo):
         node = self._pick_node_for(actor.resources, actor.scheduling)
         if node is None:
-            # No feasible node right now; queue until one registers.
+            # No feasible node right now; retried on node registration and
+            # on every heartbeat (resource view refresh).
             if actor.actor_id not in self._pending_actor_queue:
+                logger.info("actor %s queued (no feasible node; need %s)",
+                            actor.actor_id, actor.resources)
                 self._pending_actor_queue.append(actor.actor_id)
             return
         actor.node_id = node.node_id
@@ -365,17 +379,32 @@ class GcsServer:
                 "resources": actor.resources,
                 "pg_id": actor.scheduling.get("placement_group_id"),
                 "bundle_index": actor.scheduling.get("bundle_index", 0) or 0,
-            })
+            }, timeout=240)
             actor.address = reply["address"]
             actor.state = ALIVE
+            actor.creation_attempts = 0  # fresh retry budget per (re)start
+            logger.debug("actor %s alive at %s", actor.actor_id,
+                         actor.address)
             self._wake_waiters(actor)
             await self._publish("actors", {"event": "alive", "actor": actor.public()})
         except Exception as e:
             logger.warning("actor %s creation on node %s failed: %s",
                            actor.actor_id, node.node_id, e)
+            # Spawn flakiness (worker stuck in startup, transient node load)
+            # is retried with a fresh process before burning a user-visible
+            # restart (reference: GcsActorScheduler reschedules on failure).
             for k, v in actor.resources.items():
-                node.resources_available[k] = node.resources_available.get(k, 0.0) + v
-            await self._on_actor_failure(actor, f"creation failed: {e}")
+                node.resources_available[k] = \
+                    node.resources_available.get(k, 0.0) + v
+            actor.node_id = None
+            actor.address = None
+            if actor.creation_attempts < 3:
+                actor.creation_attempts += 1
+                logger.info("actor %s: creation retry %d", actor.actor_id,
+                            actor.creation_attempts)
+                await self._schedule_actor(actor)
+            else:
+                await self._on_actor_failure(actor, f"creation failed: {e}")
 
     async def _try_schedule_pending(self):
         queue, self._pending_actor_queue = self._pending_actor_queue, []

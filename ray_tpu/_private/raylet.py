@@ -51,6 +51,7 @@ class WorkerHandle:
     lease_id: Optional[str] = None
     busy: bool = False
     actor_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
+    actor_created: bool = False  # create_actor completed on this worker
 
 
 @dataclass
@@ -155,6 +156,10 @@ class Raylet:
             await asyncio.sleep(0.2)
 
     async def _on_worker_death(self, w: WorkerHandle):
+        logger.warning(
+            "worker %s died rc=%s (actor=%s lease=%s)",
+            w.worker_id.hex()[:8], w.proc.returncode, w.actor_id,
+            w.lease_id)
         self.workers.pop(w.worker_id, None)
         if w in self.idle_workers:
             self.idle_workers.remove(w)
@@ -173,14 +178,20 @@ class Raylet:
                     if pg_id else self.resources_available
                 for k, v in resources.items():
                     pool[k] = pool.get(k, 0.0) + v
-            try:
-                await self.gcs_conn.request({
-                    "type": "report_actor_death",
-                    "actor_id": w.actor_id,
-                    "reason": f"worker process exited with code {w.proc.returncode}",
-                })
-            except Exception:
-                pass
+            # Only report deaths of actors that finished creation.  A worker
+            # dying mid-create already fails the pending create_actor_worker
+            # request — a duplicate death report would race the GCS's
+            # creation retry and double-schedule the actor.
+            if w.actor_created:
+                try:
+                    await self.gcs_conn.request({
+                        "type": "report_actor_death",
+                        "actor_id": w.actor_id,
+                        "reason": f"worker process exited with code "
+                                  f"{w.proc.returncode}",
+                    })
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------ gcs push
 
@@ -257,22 +268,50 @@ class Raylet:
             if pg_id else self.resources_available
         for k, v in resources.items():
             pool[k] = pool.get(k, 0.0) - v
+        w = None
         try:
             w = self._spawn_worker(actor_id=msg["actor_id"])
             w.actor_resources = (resources, pg_id, msg.get("bundle_index", 0))
-            await asyncio.wait_for(w.ready, timeout=120)
+            logger.debug("actor %s: spawned worker %s pid=%s, waiting ready",
+                         msg["actor_id"][:8], w.worker_id.hex()[:8],
+                         w.proc.pid)
+            # Bounded: worker startup can stall under load (1-core machines,
+            # jax import storms); a clean failure here lets the GCS retry
+            # with a fresh process instead of wedging actor creation forever.
+            try:
+                await asyncio.wait_for(w.ready, timeout=60)
+            except asyncio.TimeoutError:
+                raise RuntimeError(
+                    f"worker pid={w.proc.pid} failed to register within 60s")
+            logger.debug("actor %s: worker ready, sending create_actor",
+                         msg["actor_id"][:8])
             reply = await w.conn.request({
                 "type": "create_actor",
                 "actor_id": msg["actor_id"],
                 "creation_spec": msg["creation_spec"],
-            })
+            }, timeout=120)
+            w.actor_created = True
+            logger.debug("actor %s: create_actor ok", msg["actor_id"][:8])
             if not reply.get("ok"):
                 raise RuntimeError(
                     f"actor constructor failed: {reply.get('error')}")
             return {"address": w.address, "worker_id": w.worker_id.hex()}
         except Exception:
-            for k, v in resources.items():
-                pool[k] = pool.get(k, 0.0) + v
+            # Return the resources exactly once.  If the worker already died
+            # and was reaped, _on_worker_death returned them (and popped the
+            # worker); otherwise we untrack it here so the reap loop can't
+            # double-return, then give them back ourselves.
+            still = self.workers.pop(w.worker_id, None) if w else None
+            if w is None or still is not None:
+                for k, v in resources.items():
+                    pool[k] = pool.get(k, 0.0) + v
+            if still is not None:
+                still.actor_resources = None
+                still.actor_id = None
+                try:
+                    still.proc.terminate()
+                except Exception:
+                    pass
             raise
 
     async def _kill_actor_worker(self, msg: dict) -> dict:

@@ -35,6 +35,12 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16        # compute dtype (params stay f32)
     remat: bool = True
     attention: str = "dense"         # "dense" | "ring" (ring needs sp>1)
+    # MoE (0 = dense FFN).  Experts shard over the ep mesh axis; routing is
+    # GShard/Switch-style capacity-bounded dispatch (ray_tpu/ops/moe.py).
+    num_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01       # load-balance loss weight
 
     @property
     def head_dim(self) -> int:
@@ -68,6 +74,26 @@ def gpt_init(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
         return {"scale": jnp.ones(shape, jnp.float32),
                 "bias": jnp.zeros(shape, jnp.float32)}
 
+    if cfg.num_experts:
+        E = cfg.num_experts
+        ek = jax.random.split(k[6], 3)
+        mlp = {
+            "router": scale * jax.random.normal(ek[0], (L, D, E),
+                                                jnp.float32),
+            "wi": scale * jax.random.normal(ek[1], (L, E, D, M), jnp.float32),
+            "bi": jnp.zeros((L, E, M), jnp.float32),
+            "wo": rscale * jax.random.normal(ek[2], (L, E, M, D),
+                                             jnp.float32),
+            "bo": jnp.zeros((L, E, D), jnp.float32),
+        }
+    else:
+        mlp = {
+            "wi": scale * jax.random.normal(k[4], (L, D, M), jnp.float32),
+            "bi": jnp.zeros((L, M), jnp.float32),
+            "wo": rscale * jax.random.normal(k[5], (L, M, D), jnp.float32),
+            "bo": jnp.zeros((L, D), jnp.float32),
+        }
+
     return {
         "wte": scale * jax.random.normal(k[0], (V, D), jnp.float32),
         "wpe": scale * jax.random.normal(k[1], (cfg.max_seq_len, D),
@@ -82,12 +108,7 @@ def gpt_init(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
                 "bo": jnp.zeros((L, D), jnp.float32),
             },
             "ln2": norm((L, D)),
-            "mlp": {
-                "wi": scale * jax.random.normal(k[4], (L, D, M), jnp.float32),
-                "bi": jnp.zeros((L, M), jnp.float32),
-                "wo": rscale * jax.random.normal(k[5], (L, M, D), jnp.float32),
-                "bo": jnp.zeros((L, D), jnp.float32),
-            },
+            "mlp": mlp,
         },
         "ln_f": norm((D,)),
     }
@@ -95,6 +116,23 @@ def gpt_init(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
 
 def gpt_param_axes(cfg: GPTConfig) -> Dict[str, Any]:
     """Logical-axis annotation pytree matching `gpt_init`'s output."""
+    if cfg.num_experts:
+        # Router stays expert-replicated (every token scores every expert);
+        # expert weights shard on the leading E dim -> ep mesh axis.
+        mlp = {
+            "router": ("layers", "embed", None),
+            "wi": ("layers", "expert", "embed", "mlp"),
+            "bi": ("layers", "expert", "mlp"),
+            "wo": ("layers", "expert", "mlp", "embed"),
+            "bo": ("layers", "expert", "embed"),
+        }
+    else:
+        mlp = {
+            "wi": ("layers", "embed", "mlp"),
+            "bi": ("layers", "mlp"),
+            "wo": ("layers", "mlp", "embed"),
+            "bo": ("layers", "norm"),
+        }
     return {
         # wte sharded on embed (not vocab): token lookup is a gather, and a
         # vocab-sharded gather forces SPMD full rematerialization; the tied
@@ -109,12 +147,7 @@ def gpt_param_axes(cfg: GPTConfig) -> Dict[str, Any]:
                 "bo": ("layers", "norm"),
             },
             "ln2": {"scale": ("layers", "norm"), "bias": ("layers", "norm")},
-            "mlp": {
-                "wi": ("layers", "embed", "mlp"),
-                "bi": ("layers", "mlp"),
-                "wo": ("layers", "mlp", "embed"),
-                "bo": ("layers", "norm"),
-            },
+            "mlp": mlp,
         },
         "ln_f": {"scale": ("norm",), "bias": ("norm",)},
     }
@@ -140,7 +173,11 @@ def _dense_causal_attention(q, k, v):
 
 def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
            attn_fn: Callable, x, layer_params):
-    """One transformer block. `layer_params` has the [L] dim already sliced."""
+    """One transformer block. `layer_params` has the [L] dim already sliced.
+
+    Returns (x, aux) — aux is the MoE load-balance loss for this layer
+    (0.0 for a dense FFN) so the scan over layers can accumulate it.
+    """
     lc = (lambda a, ax: with_logical_constraint(a, rules, ax)) if rules \
         else (lambda a, ax: a)
     p = layer_params
@@ -158,20 +195,27 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
     x = lc(x, ("batch", "seq", "embed"))
 
     h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
-    h = jnp.einsum("bsd,dm->bsm", h, p["mlp"]["wi"].astype(dt)) \
-        + p["mlp"]["bi"].astype(dt)
-    h = lc(h, ("batch", "seq", "mlp"))
-    h = jax.nn.gelu(h)
-    h = jnp.einsum("bsm,md->bsd", h, p["mlp"]["wo"].astype(dt)) \
-        + p["mlp"]["bo"].astype(dt)
+    if cfg.num_experts:
+        from ray_tpu.ops.moe import moe_mlp
+        h, aux = moe_mlp(h, p["mlp"], top_k=cfg.expert_top_k,
+                         capacity_factor=cfg.capacity_factor, lc=lc)
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        h = jnp.einsum("bsd,dm->bsm", h, p["mlp"]["wi"].astype(dt)) \
+            + p["mlp"]["bi"].astype(dt)
+        h = lc(h, ("batch", "seq", "mlp"))
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("bsm,md->bsd", h, p["mlp"]["wo"].astype(dt)) \
+            + p["mlp"]["bo"].astype(dt)
     x = x + h
-    return lc(x, ("batch", "seq", "embed"))
+    return lc(x, ("batch", "seq", "embed")), aux
 
 
-def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
-                rules: Optional[LogicalAxisRules] = None,
-                mesh=None) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V] (f32).
+def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                         cfg: GPTConfig,
+                         rules: Optional[LogicalAxisRules] = None,
+                         mesh=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] f32, moe_aux_loss scalar).
 
     Layers run under one `lax.scan` over the stacked [L] params — XLA sees a
     single while-loop body (fast compiles, and the [L] dim shards over pp).
@@ -204,12 +248,21 @@ def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
         block = jax.checkpoint(block)
 
     def scan_body(carry, layer_params):
-        return block(carry, layer_params), None
+        return block(carry, layer_params)
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, aux = jax.lax.scan(scan_body, x, params["layers"])
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), jnp.sum(aux)
+
+
+def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
+                rules: Optional[LogicalAxisRules] = None,
+                mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (f32); see
+    `gpt_forward_with_aux` for the MoE aux-loss variant."""
+    logits, _ = gpt_forward_with_aux(params, tokens, cfg, rules, mesh)
+    return logits
 
 
 def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
@@ -221,14 +274,16 @@ def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
     pipelined variant in `ray_tpu.parallel.pipeline` plugs in here, so loss
     changes apply to every execution mode at once)."""
     toks = batch["tokens"]
+    aux = jnp.zeros((), jnp.float32)
     if forward_fn is None:
-        logits = gpt_forward(params, toks[:, :-1], cfg, rules, mesh)
+        logits, aux = gpt_forward_with_aux(params, toks[:, :-1], cfg, rules,
+                                           mesh)
     else:
         logits = forward_fn(params, toks[:, :-1])
     targets = toks[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_coef * aux
 
 
 # ---------------------------------------------------------------- train step

@@ -95,6 +95,9 @@ def gpt_forward_pipelined(params: Dict[str, Any], tokens, cfg, mesh, *,
         f"pipelined forward only supports dense attention for now, got "
         f"{cfg.attention!r} (ring/flash inside a pipeline stage is future "
         f"work — use a pp=1 mesh with sp/tp for long sequences)")
+    assert not cfg.num_experts, (
+        "MoE inside a pipeline stage is not supported yet (the load-balance "
+        "aux loss would be silently dropped) — use ep on a pp=1 mesh")
     pp = mesh.shape.get("pp", 1)
     assert cfg.num_layers % pp == 0, (
         f"num_layers {cfg.num_layers} not divisible by pp={pp}")
@@ -109,7 +112,8 @@ def gpt_forward_pipelined(params: Dict[str, Any], tokens, cfg, mesh, *,
     x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:S][None]
     x_mbs = x.reshape(M, B // M, S, -1)
 
-    block = functools.partial(_block, cfg, None, _dense_causal_attention)
+    raw_block = functools.partial(_block, cfg, None, _dense_causal_attention)
+    block = lambda x, lp: raw_block(x, lp)[0]  # noqa: E731  (drop dense aux=0)
     data = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
     mb_spec = P(None, data, None, None)
     piped = jax.shard_map(

@@ -81,6 +81,11 @@ class PopulationBasedTraining(TrialScheduler):
         if trial in bottom and trial not in top:
             donor = self._rng.choice(top)
             new_config = self.explore(donor.config)
+            # Drop the victim's stale score: until it reports from the
+            # donor's checkpoint it must not participate in quantile
+            # ranking (otherwise two near-tied trials exploit each other
+            # every report — ping-pong churn that never converges).
+            self._scores.pop(trial.trial_id, None)
             # The runner performs checkpoint transfer + in-place restart.
             runner.request_exploit(trial, donor, new_config)
         return self.CONTINUE

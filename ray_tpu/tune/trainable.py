@@ -83,10 +83,25 @@ class FunctionTrainable(Trainable):
     _fn: Callable = None  # set by subclass factory
 
     def setup(self, config):
-        self._queue: "queue.Queue" = queue.Queue()
+        # maxsize=1: session.report blocks until the driver consumes the
+        # result (the reference's report handshake).  Besides backpressure,
+        # this is what makes reset_config safe: an orphaned fn thread parks
+        # on a discarded queue's put() instead of free-running.
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
         self._started = False
         self._restore_checkpoint: Optional[Checkpoint] = None
         self._error: Optional[str] = None
+
+    def reset_config(self, new_config):
+        """In-place PBT exploit: orphan the running fn thread (daemonic; it
+        parks on its now-discarded bounded queue) and arm a fresh start.
+        Avoids a full actor restart per exploit — on the reference this is
+        the reuse_actors fast path."""
+        self._queue = queue.Queue(maxsize=1)
+        self._started = False
+        self._restore_checkpoint = None
+        self._latest_fn_checkpoint = None
+        return True
 
     def _start(self):
         fn = type(self)._fn

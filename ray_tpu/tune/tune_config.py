@@ -19,7 +19,10 @@ class TuneConfig:
     max_concurrent_trials: Optional[int] = None
     search_alg: Optional[object] = None
     scheduler: Optional[object] = None
-    reuse_actors: bool = False
+    # Default True: trainables opt in via reset_config (FunctionTrainable
+    # does); class trainables returning False still get a fresh actor.
+    # Avoids a worker-process restart per PBT exploit.
+    reuse_actors: bool = True
     seed: Optional[int] = None
 
     def __post_init__(self):

@@ -128,13 +128,18 @@ def test_median_stopping(ray_start):
 
 
 def _pbt_fn(config):
+    # Standard PBT contract: checkpoint carries the step too, so an
+    # exploited trial resumes the donor's progress instead of restarting
+    # its 30 iterations from scratch (which would never terminate under
+    # repeated exploits).
     ckpt = session.get_checkpoint()
-    state = ckpt.to_dict() if ckpt else {"value": 0.0}
+    state = ckpt.to_dict() if ckpt else {"value": 0.0, "step": 0}
     v = state["value"]
-    for _ in range(30):
+    for step in range(state.get("step", 0), 30):
         v += config["rate"]
         tune.report({"value": v},
-                    checkpoint=Checkpoint.from_dict({"value": v}))
+                    checkpoint=Checkpoint.from_dict(
+                        {"value": v, "step": step + 1}))
 
 
 def test_pbt_exploits(ray_start):
