@@ -86,6 +86,13 @@ class CoreWorker:
         self.owned: set = set()
         self._ref_lock = threading.Lock()
         self._local_refs: Dict[str, int] = {}
+        # Distributed refcounting + lineage (reference: reference_count.h,
+        # task_manager.h, object_recovery_manager.h):
+        self._borrowing: set = set()            # oids we borrow (owner != us)
+        self._borrowers: Dict[str, set] = {}    # oid -> borrower addresses
+        self._borrow_acks: list = []            # in-flight borrow_add futures
+        self._lineage: Dict[str, dict] = {}     # oid -> producing task record
+        self._reconstructing: Dict[str, asyncio.Future] = {}
 
         self.plasma: Optional[PlasmaClient] = None
         if store_name:
@@ -162,24 +169,49 @@ class CoreWorker:
             mtype = msg["type"]
             if mtype == "get_object":
                 return await self._h_get_object(msg)
+            if mtype == "wait_object":
+                return await self._h_wait_object(msg)
+            if mtype == "borrow_add":
+                return await self._h_borrow_add(msg)
+            if mtype == "borrow_remove":
+                return await self._h_borrow_remove(msg)
+            if mtype == "reconstruct_object":
+                return await self._h_reconstruct_object(msg)
             if self.task_executor is not None:
                 return await self.task_executor.handle(conn, msg)
             raise ValueError(f"core worker: unknown message {mtype}")
         return handle
 
-    async def _h_get_object(self, msg: dict):
-        """Owner-fetch: another process resolves an object we own."""
-        oid = msg["object_id"]
-        deadline = time.monotonic() + msg.get("timeout", 300.0)
+    async def _h_wait_object(self, msg: dict):
+        """Metadata-only readiness long-poll (reference: wait is
+        metadata-only with fetch_local control — no value bytes move)."""
+        ready = await self._await_in_store(
+            msg["object_id"], time.monotonic() + msg.get("timeout", 300.0))
+        return {"ready": ready}
+
+    async def _h_reconstruct_object(self, msg: dict):
+        ok = await self._reconstruct(msg["object_id"])
+        return {"ok": ok}
+
+    async def _await_in_store(self, oid: str, deadline: float) -> bool:
+        """Long-poll until `oid` has a memory-store entry; False on timeout."""
         while oid not in self.memory_store:
             ev = self.object_events.setdefault(oid, asyncio.Event())
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                return {"status": "timeout"}
+                return False
             try:
                 await asyncio.wait_for(ev.wait(), timeout=remaining)
             except asyncio.TimeoutError:
-                return {"status": "timeout"}
+                return False
+        return True
+
+    async def _h_get_object(self, msg: dict):
+        """Owner-fetch: another process resolves an object we own."""
+        oid = msg["object_id"]
+        deadline = time.monotonic() + msg.get("timeout", 300.0)
+        if not await self._await_in_store(oid, deadline):
+            return {"status": "timeout"}
         kind, data = self.memory_store[oid]
         if kind == "val":
             return {"status": "inline", "data": data}
@@ -189,27 +221,100 @@ class CoreWorker:
 
     # ------------------------------------------------------------ refcounts
 
-    def add_local_ref(self, oid: ObjectID):
+    def add_local_ref(self, oid: ObjectID, owner_address: str = ""):
+        h = oid.hex()
+        register = False
         with self._ref_lock:
-            self._local_refs[oid.hex()] = self._local_refs.get(oid.hex(), 0) + 1
+            n = self._local_refs.get(h, 0) + 1
+            self._local_refs[h] = n
+            # First ref to someone else's object: register as a borrower so
+            # the owner keeps the value alive past its own local refcount
+            # (reference: ReferenceCounter borrower bookkeeping,
+            # reference_count.h:61).
+            if (n == 1 and owner_address and owner_address != self.address
+                    and h not in self._borrowing):
+                self._borrowing.add(h)
+                register = True
+        if register and not self.loop.is_closed():
+            fut = asyncio.run_coroutine_threadsafe(
+                self._send_borrow(h, owner_address, add=True), self.loop)
+            # Prune finished acks: only executors drain this list (drivers
+            # never call flush_borrow_acks), so it must self-limit.
+            self._borrow_acks = [f for f in self._borrow_acks
+                                 if not f.done()] + [fut]
 
-    def remove_local_ref(self, oid: ObjectID):
+    def remove_local_ref(self, oid: ObjectID, owner_address: str = ""):
+        h = oid.hex()
+        deregister = False
         with self._ref_lock:
-            n = self._local_refs.get(oid.hex(), 0) - 1
+            n = self._local_refs.get(h, 0) - 1
             if n > 0:
-                self._local_refs[oid.hex()] = n
+                self._local_refs[h] = n
                 return
-            self._local_refs.pop(oid.hex(), None)
-        if not self.loop.is_closed():
-            self.loop.call_soon_threadsafe(self._free_object, oid)
+            self._local_refs.pop(h, None)
+            if h in self._borrowing:
+                self._borrowing.discard(h)
+                deregister = True
+        if self.loop.is_closed():
+            return
+        if deregister:
+            asyncio.run_coroutine_threadsafe(
+                self._send_borrow(h, owner_address, add=False), self.loop)
+        self.loop.call_soon_threadsafe(self._free_object, oid)
+
+    async def _send_borrow(self, oid_hex: str, owner: str, add: bool):
+        try:
+            conn = await self._get_worker_conn(owner)
+            await conn.request({"type": "borrow_add" if add else
+                                "borrow_remove",
+                                "object_id": oid_hex,
+                                "borrower": self.address}, timeout=60)
+        except Exception:
+            # Owner gone: nothing to keep alive / release.
+            pass
+
+    async def flush_borrow_acks(self):
+        """Await in-flight borrow registrations.  Executors call this before
+        replying to a task so the owner learns about borrows while the
+        submitter still pins the args (closing the free-vs-borrow race)."""
+        acks, self._borrow_acks = self._borrow_acks, []
+        for fut in acks:
+            try:
+                await asyncio.wrap_future(fut)
+            except Exception:
+                pass
+
+    async def _h_borrow_add(self, msg: dict):
+        h = msg["object_id"]
+        if h not in self.owned:
+            return {"ok": False}  # already freed -- borrower raced the free
+        self._borrowers.setdefault(h, set()).add(msg["borrower"])
+        return {"ok": True}
+
+    async def _h_borrow_remove(self, msg: dict):
+        h = msg["object_id"]
+        s = self._borrowers.get(h)
+        if s is not None:
+            s.discard(msg["borrower"])
+            if not s:
+                del self._borrowers[h]
+                with self._ref_lock:
+                    no_local = self._local_refs.get(h, 0) == 0
+                if no_local:
+                    self._free_object(ObjectID.from_hex(h))
+        return {"ok": True}
 
     def _free_object(self, oid: ObjectID):
         """Zero local refs: owners free the value (reference_count.h eager
-        deletion); borrowers just drop local state."""
+        deletion) unless borrowers still hold it; borrowers just drop
+        local state."""
         h = oid.hex()
         if h not in self.owned:
             return
+        if self._borrowers.get(h):
+            return  # a borrower keeps it alive; freed on last borrow_remove
         self.owned.discard(h)
+        self._lineage.pop(h, None)
         entry = self.memory_store.pop(h, None)
         self.object_events.pop(h, None)
         if self.plasma is not None and (entry is None or entry[0] == "plasma"):
@@ -225,6 +330,10 @@ class CoreWorker:
 
     def _store_local(self, oid_hex: str, kind: str, data):
         self.memory_store[oid_hex] = (kind, data)
+        if kind != "plasma":
+            # In-process values/errors never take the plasma-lost path;
+            # their lineage (full task spec + pinned args) can go.
+            self._lineage.pop(oid_hex, None)
         ev = self.object_events.get(oid_hex)
         if ev is not None:
             ev.set()
@@ -299,13 +408,24 @@ class CoreWorker:
                 ok = await self._pull_to_local(h)
                 if ok:
                     continue
+                # We own it and every copy is gone: re-execute the
+                # producing task from lineage.
+                if h in self.owned:
+                    if await self._reconstruct(h):
+                        continue
+                    raise rex.ObjectLostError(
+                        f"object {h[:16]} lost: all copies gone and no "
+                        f"lineage to reconstruct from (ray.put objects are "
+                        f"not recoverable)")
             # Ask the owner (memory-store objects of other processes, or
             # discover that it lives in plasma somewhere).
             if owner and owner != self.address:
+                owner_reachable = False
                 try:
                     owner_conn = await self._get_worker_conn(owner)
                     reply = await owner_conn.request(
                         {"type": "get_object", "object_id": h}, timeout=310)
+                    owner_reachable = True
                     if reply["status"] == "inline":
                         return ("val", reply["data"])
                     if reply["status"] == "error":
@@ -313,20 +433,68 @@ class CoreWorker:
                     if reply["status"] == "plasma":
                         if await self._pull_to_local(h):
                             continue
+                        # Copies lost: ask the owner to reconstruct from
+                        # lineage, then pull again.
+                        rec = await owner_conn.request(
+                            {"type": "reconstruct_object", "object_id": h},
+                            timeout=600)
+                        if rec.get("ok") and await self._pull_to_local(h):
+                            continue
                 except ConnectionLost:
                     pass
-                # Owner gone; try the object directory anyway.
+                # Owner gone (or reconstruction failed); try the object
+                # directory anyway — another node may still hold a copy.
                 if await self._pull_to_local(h):
                     continue
+                detail = ("owner could not reconstruct it"
+                          if owner_reachable else
+                          f"owner {owner} unreachable")
                 raise rex.ObjectLostError(
-                    f"object {h[:16]} lost: owner {owner} unreachable and no "
-                    f"copies found")
+                    f"object {h[:16]} lost: {detail} and no copies found")
             if owner == self.address or not owner:
                 # We own it but it is not ready yet -> wait for task completion.
                 ev = self.object_events.setdefault(h, asyncio.Event())
                 await ev.wait()
                 ev.clear()
                 continue
+
+    async def _reconstruct(self, oid_hex: str) -> bool:
+        """Owner-side object recovery: re-execute the producing task to
+        regenerate a lost plasma object (reference:
+        object_recovery_manager.h:41).  Returns True if the object is
+        available again."""
+        if oid_hex not in self.owned:
+            return False
+        rec = self._lineage.get(oid_hex)
+        if rec is None:
+            return False  # ray.put objects / depth-exhausted: unrecoverable
+        inflight = self._reconstructing.get(oid_hex)
+        if inflight is not None:
+            return await inflight
+        fut = asyncio.get_running_loop().create_future()
+        for oid in rec["return_ids"]:
+            self._reconstructing[oid.hex()] = fut
+        logger.info("reconstructing object %s via task %s", oid_hex[:16],
+                    rec["spec"]["name"])
+        try:
+            # Don't pre-clear sibling entries: a failed resubmit must leave
+            # healthy siblings resolvable, and a successful one overwrites
+            # the stale 'plasma' entries anyway.
+            try:
+                reply = await self._submit_once(rec["spec"], rec["resources"],
+                                                rec["scheduling"])
+                ok = bool(reply.get("ok"))
+                if ok:
+                    await self._store_task_returns(reply, rec["return_ids"])
+            except Exception:
+                ok = False
+            fut.set_result(ok)
+            return ok
+        finally:
+            for oid in rec["return_ids"]:
+                self._reconstructing.pop(oid.hex(), None)
+            if not fut.done():
+                fut.set_result(False)
 
     async def _pull_to_local(self, oid_hex: str) -> bool:
         if self.raylet is None or self.plasma is None:
@@ -343,9 +511,38 @@ class CoreWorker:
              timeout: Optional[float] = None):
         return self._run(self._wait_async(refs, num_returns, timeout))
 
+    async def _probe_ready(self, oid: ObjectID, owner: str):
+        """Readiness check that never moves value bytes (reference: wait is
+        metadata-only — round-1 version pulled whole objects to test
+        readiness, dragging gigabytes across nodes).  Retries transient
+        owner-poll failures forever; the caller bounds total time."""
+        h = oid.hex()
+        while True:
+            entry = self.memory_store.get(h)
+            if entry is not None:
+                return  # val/err ready, or plasma -> produced somewhere
+            if self.plasma is not None and self.plasma.contains(oid):
+                return
+            if owner and owner != self.address:
+                try:
+                    owner_conn = await self._get_worker_conn(owner)
+                    # Client timeout exceeds the server's long-poll deadline
+                    # so an idle poll round-trips cleanly instead of racing.
+                    reply = await owner_conn.request(
+                        {"type": "wait_object", "object_id": h,
+                         "timeout": 300.0}, timeout=310)
+                    if reply.get("ready"):
+                        return
+                except Exception:
+                    await asyncio.sleep(0.5)
+                continue
+            ev = self.object_events.setdefault(h, asyncio.Event())
+            await ev.wait()
+            ev.clear()
+
     async def _wait_async(self, refs, num_returns, timeout):
         pending = {asyncio.ensure_future(
-            self._resolve_bytes(r.id, r.owner_address), loop=self.loop): r
+            self._probe_ready(r.id, r.owner_address), loop=self.loop): r
             for r in refs}
         ready: List[ObjectRef] = []
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -356,7 +553,10 @@ class CoreWorker:
             if not done:
                 break
             for fut in done:
-                ready.append(pending.pop(fut))
+                ref = pending.pop(fut)
+                if fut.cancelled() or fut.exception() is not None:
+                    continue  # probe failed -> ref stays not-ready
+                ready.append(ref)
         for fut in pending:
             fut.cancel()
         ready_set = set(ready[:num_returns])
@@ -396,14 +596,21 @@ class CoreWorker:
         hold them until the task completes, or an owner seeing its local
         count hit zero would eagerly free a value an in-flight task still
         needs (reference: ReferenceCounter submitted-task references,
-        reference_count.h:61)."""
-        out_args = [self._serialize_one(a) for a in args]
-        out_kwargs = {k: self._serialize_one(v) for k, v in kwargs.items()}
+        reference_count.h:61).  Large pass-by-value args are promoted to
+        plasma objects; their temp ObjectRefs join the pin list so they are
+        freed when the submission drops them (round-1 leaked these forever)."""
         pinned = [a for a in args if isinstance(a, ObjectRef)]
         pinned += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        # Refs nested inside containers are collected during pickling and
+        # pinned too — otherwise `f.remote([ref]); del ref` could free the
+        # object before the executor registers its borrow.
+        with object_ref_mod.observe_pickled_refs(pinned):
+            out_args = [self._serialize_one(a, pinned) for a in args]
+            out_kwargs = {k: self._serialize_one(v, pinned)
+                          for k, v in kwargs.items()}
         return out_args, out_kwargs, pinned
 
-    def _serialize_one(self, value):
+    def _serialize_one(self, value, pinned: list):
         if isinstance(value, ObjectRef):
             entry = self.memory_store.get(value.hex())
             if entry is not None and entry[0] == "val" and \
@@ -415,8 +622,10 @@ class CoreWorker:
             return ("v", ser.to_bytes())
         oid = ObjectID.for_task_return(task_id_generator.next(), 0)
         self._run_on_loop_sync(self._put_serialized(oid, ser))
-        # Keep a ref alive until the task consumes it by attaching it to the
-        # entry; the executor never refcounts these.
+        # The temp ref holds one local count until the submitter releases
+        # the pin list (task completion / actor death), then the normal
+        # zero-count path frees the plasma copy.
+        pinned.append(ObjectRef(oid, self.address))
         return ("ref", oid.hex(), self.address)
 
     def _run_on_loop_sync(self, coro):
@@ -468,6 +677,16 @@ class CoreWorker:
             self.loop)
         for oid in return_ids:
             self.owned.add(oid.hex())
+            # Lineage: the producing task's spec, kept while we own the
+            # object so a lost plasma copy can be re-executed (reference:
+            # object_recovery_manager.h:41 + task_manager.h lineage pinning).
+            # Pinned arg refs ride along so reconstruction can't race their
+            # release.
+            self._lineage[oid.hex()] = {
+                "spec": spec, "resources": resources,
+                "scheduling": scheduling, "return_ids": return_ids,
+                "pins": pinned_args,
+            }
         return refs
 
     async def _submit_and_track(self, spec, resources, scheduling, max_retries,
@@ -553,6 +772,8 @@ class CoreWorker:
 
     async def _store_task_returns(self, reply: dict, return_ids):
         for (oid_hex, kind, data), oid in zip(reply["returns"], return_ids):
+            if oid_hex not in self.owned:
+                continue  # freed while the task (or a reconstruction) ran
             if kind == "inline":
                 self._store_local(oid_hex, "val", data)
             else:  # plasma, located on executor's node (directory has it)

@@ -25,6 +25,32 @@ def set_refcount_sink(sink):
     _refcount_sink = sink
 
 
+import threading as _threading
+
+_pickle_observer = _threading.local()
+
+
+class observe_pickled_refs:
+    """Context manager collecting every ObjectRef pickled inside it.
+
+    Lets serialize_args pin refs *nested* in containers (the reference
+    tracks these as 'contained in owned object' references,
+    reference_count.h) — without this, only top-level args were pinned and
+    a nested ref could be freed by the owner mid-submission."""
+
+    def __init__(self, sink: list):
+        self.sink = sink
+
+    def __enter__(self):
+        self.prev = getattr(_pickle_observer, "sink", None)
+        _pickle_observer.sink = self.sink
+        return self.sink
+
+    def __exit__(self, *exc):
+        _pickle_observer.sink = self.prev
+        return False
+
+
 class ObjectRef:
     __slots__ = ("id", "owner_address", "__weakref__")
 
@@ -32,7 +58,7 @@ class ObjectRef:
         self.id = object_id
         self.owner_address = owner_address
         if _refcount_sink is not None:
-            _refcount_sink.add_local_ref(self.id)
+            _refcount_sink.add_local_ref(self.id, owner_address)
 
     def hex(self) -> str:
         return self.id.hex()
@@ -52,11 +78,14 @@ class ObjectRef:
     def __del__(self):
         if _refcount_sink is not None:
             try:
-                _refcount_sink.remove_local_ref(self.id)
+                _refcount_sink.remove_local_ref(self.id, self.owner_address)
             except Exception:
                 pass
 
     def __reduce__(self):
+        sink = getattr(_pickle_observer, "sink", None)
+        if sink is not None:
+            sink.append(self)
         return (ObjectRef, (self.id, self.owner_address))
 
     # Allow `await ref` inside async actors / driver coroutines.

@@ -472,19 +472,29 @@ class Raylet:
         if loc is None or not loc["nodes"]:
             return {"ok": False, "error": "no locations"}
         nodes = await self.gcs_conn.request({"type": "get_nodes"})
-        addr = None
-        for n in nodes:
-            if n["node_id"] in loc["nodes"] and n["alive"] and \
-                    n["node_id"] != self.node_id.hex():
-                addr = n["address"]
-                break
-        if addr is None:
+        candidates = [n["address"] for n in nodes
+                      if n["node_id"] in loc["nodes"] and n["alive"] and
+                      n["node_id"] != self.node_id.hex()]
+        if not candidates:
             return {"ok": False, "error": "no live remote location"}
-        peer = await self._peer(addr)
-        first = await peer.request({"type": "fetch_object",
-                                    "object_id": msg["object_id"], "offset": 0})
-        if not first.get("found"):
-            return {"ok": False, "error": "object missing at remote"}
+        # A location can be stale (node just died, GCS hasn't noticed):
+        # treat per-node connect/fetch failures as "try the next copy".
+        peer = first = None
+        for addr in candidates:
+            try:
+                peer = await self._peer(addr)
+                first = await peer.request(
+                    {"type": "fetch_object",
+                     "object_id": msg["object_id"], "offset": 0},
+                    timeout=120)
+                if first.get("found"):
+                    break
+            except Exception as e:
+                logger.debug("pull %s from %s failed: %s",
+                             msg["object_id"][:16], addr, e)
+            first = None
+        if first is None:
+            return {"ok": False, "error": "object missing at all locations"}
         total = first["total"]
         if self.plasma.contains(oid):
             return {"ok": True}

@@ -67,6 +67,9 @@ class TaskExecutor:
             loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
                 self.core.exec_pool, lambda: fn(*args, **kwargs))
+            # Borrow registrations must reach owners before the reply
+            # releases the submitter's arg pins.
+            await self.core.flush_borrow_acks()
             return self._pack_returns(spec, result)
         except SystemExit as e:
             asyncio.get_running_loop().call_later(0.2, os._exit,
@@ -109,6 +112,7 @@ class TaskExecutor:
             loop = asyncio.get_running_loop()
             self.actor_instance = await loop.run_in_executor(
                 self.core.exec_pool, lambda: cls(*args, **kwargs))
+            await self.core.flush_borrow_acks()
             title = getattr(cls, "__name__", "Actor")
             _set_proc_title(f"ray_tpu::actor::{title}")
             return {"ok": True}
@@ -148,6 +152,7 @@ class TaskExecutor:
                 result = await fut
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],
                     "call_id": msg["call_id"]}
+            await self.core.flush_borrow_acks()
             return self._pack_returns(spec, result)
         except SystemExit:
             # exit_actor(): report intended death, reply an error to this call
