@@ -781,10 +781,11 @@ class CoreWorker:
 
     # ------------------------------------------------------------ actors
 
-    def create_actor(self, cls, args, kwargs, *, resources=None,
-                     max_restarts=0, name=None, namespace="default",
-                     get_if_exists=False, detached=False, max_concurrency=1,
-                     scheduling=None) -> str:
+    def _build_create_actor_request(self, cls, args, kwargs, *,
+                                    resources=None, max_restarts=0,
+                                    name=None, namespace="default",
+                                    get_if_exists=False, detached=False,
+                                    max_concurrency=1, scheduling=None):
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         creation_spec = cloudpickle.dumps({
             "cls": cloudpickle.dumps(cls),
@@ -793,10 +794,9 @@ class CoreWorker:
             "max_concurrency": max_concurrency,
             "name": name,
         })
-        actor_id = ActorID.from_random()
-        reply = self._run(self.gcs.request({
+        return {
             "type": "create_actor",
-            "actor_id": actor_id.hex(),
+            "actor_id": ActorID.from_random().hex(),
             "name": name,
             "namespace": namespace,
             "creation_spec": creation_spec,
@@ -806,13 +806,36 @@ class CoreWorker:
             "detached": detached,
             "get_if_exists": get_if_exists,
             "scheduling": scheduling or {},
-        }))
+        }, pinned_args
+
+    async def create_actor_async(self, cls, args, kwargs, **opts) -> str:
+        """Loop-thread-safe actor creation (async actor methods that call
+        .remote() would deadlock on the blocking path's _run)."""
+        req, pinned_args = self._build_create_actor_request(
+            cls, args, kwargs, **opts)
+        reply = await self.gcs.request(req)
+        self._pin_actor_creation(reply["actor_id"], pinned_args)
+        return reply["actor_id"]
+
+    def _pin_actor_creation(self, actor_id_hex: str, pinned_args):
         if pinned_args:
             # Creation args stay pinned for the actor's lifetime: the GCS
             # may replay the creation spec on restart at any point.
             if not hasattr(self, "_actor_creation_pins"):
                 self._actor_creation_pins = {}
-            self._actor_creation_pins[reply["actor_id"]] = pinned_args
+            self._actor_creation_pins[actor_id_hex] = pinned_args
+
+    def create_actor(self, cls, args, kwargs, *, resources=None,
+                     max_restarts=0, name=None, namespace="default",
+                     get_if_exists=False, detached=False, max_concurrency=1,
+                     scheduling=None) -> str:
+        req, pinned_args = self._build_create_actor_request(
+            cls, args, kwargs, resources=resources,
+            max_restarts=max_restarts, name=name, namespace=namespace,
+            get_if_exists=get_if_exists, detached=detached,
+            max_concurrency=max_concurrency, scheduling=scheduling)
+        reply = self._run(self.gcs.request(req))
+        self._pin_actor_creation(reply["actor_id"], pinned_args)
         return reply["actor_id"]
 
     def _actor(self, actor_id_hex: str) -> dict:

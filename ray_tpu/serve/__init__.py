@@ -1,0 +1,148 @@
+"""ray_tpu.serve — online model serving.
+
+Reference analogs: ``python/ray/serve/`` — ``serve.run`` (api.py:455),
+``@serve.deployment`` (deployment.py), ServeController reconciliation
+(controller.py:64, _private/deployment_state.py:1769), queue-aware router
+(_private/router.py:261), micro-batching (serve/batching.py), HTTP proxy
+(_private/http_proxy.py:387).
+
+TPU-first shape: replicas are actors whose handlers typically close over a
+jitted forward function — one replica per chip (or per slice via placement
+groups).  The controller reconciles declared deployments to replica actors;
+routing is client-side least-outstanding over the replica set with a cached
+view refreshed from the controller.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.controller import (CONTROLLER_NAME, ServeController,
+                                      DeploymentSpec)
+from ray_tpu.serve.router import DeploymentHandle
+
+__all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
+           "batch", "status", "start_http"]
+
+
+class Deployment:
+    """Declarative deployment wrapper produced by @serve.deployment."""
+
+    def __init__(self, cls_or_fn, name, config):
+        self._callable = cls_or_fn
+        self.name = name
+        self.config = config
+        self._init_args: tuple = ()
+        self._init_kwargs: dict = {}
+
+    def options(self, **kw) -> "Deployment":
+        d = Deployment(self._callable, kw.pop("name", self.name),
+                       {**self.config, **kw})
+        d._init_args, d._init_kwargs = self._init_args, self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = Deployment(self._callable, self.name, dict(self.config))
+        d._init_args, d._init_kwargs = args, kwargs
+        return d
+
+    def _spec(self) -> DeploymentSpec:
+        import cloudpickle
+        return DeploymentSpec(
+            name=self.name,
+            callable_blob=cloudpickle.dumps(
+                (self._callable, self._init_args, self._init_kwargs)),
+            num_replicas=self.config.get("num_replicas", 1),
+            max_concurrent_queries=self.config.get(
+                "max_concurrent_queries", 8),
+            route_prefix=self.config.get("route_prefix",
+                                         f"/{self.name}"),
+            resources=self.config.get("ray_actor_options", {}).get(
+                "resources"),
+            num_cpus=self.config.get("ray_actor_options", {}).get(
+                "num_cpus", 1.0),
+            autoscaling=self.config.get("autoscaling_config"),
+        )
+
+
+def deployment(cls_or_fn=None, *, name: Optional[str] = None, **config):
+    """Decorator declaring a deployment (reference: serve/deployment.py)."""
+    def wrap(target):
+        return Deployment(target, name or target.__name__, config)
+    if cls_or_fn is not None:
+        return wrap(cls_or_fn)
+    return wrap
+
+
+def _controller() -> "ray_tpu.actor.ActorHandle":
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        actor_cls = ray_tpu.remote(ServeController)
+        return actor_cls.options(name=CONTROLLER_NAME, lifetime="detached",
+                                 get_if_exists=True, num_cpus=0.1,
+                                 max_concurrency=64).remote()
+
+
+def run(target: Deployment, *, _blocking: bool = True) -> DeploymentHandle:
+    """Deploy (create or update) and return a handle
+    (reference: serve.run, api.py:455)."""
+    ctrl = _controller()
+    ray_tpu.get(ctrl.deploy.remote(target._spec()))
+    if _blocking:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctrl.status.remote())
+            d = st.get(target.name)
+            if d and d["running"] >= d["target"]:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"deployment {target.name} did not become ready")
+    return get_handle(target.name)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _controller())
+
+
+def status() -> Dict[str, Any]:
+    return ray_tpu.get(_controller().status.remote())
+
+
+def delete(name: str):
+    ray_tpu.get(_controller().delete_deployment.remote(name))
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start the HTTP ingress actor; returns its base URL
+    (reference: HTTPProxyActor, http_proxy.py:387)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.serve.http_ingress import HTTPIngress
+    _controller()  # make sure the controller exists for route refresh
+    ingress_cls = ray_tpu.remote(HTTPIngress)
+    ingress = ingress_cls.options(name="_serve_http", lifetime="detached",
+                                  get_if_exists=True, num_cpus=0.1,
+                                  max_concurrency=64).remote(
+        host, port, global_worker.namespace)
+    addr = ray_tpu.get(ingress.address.remote())
+    return f"http://{addr[0]}:{addr[1]}"
+
+
+def shutdown():
+    """Tear down all deployments, the controller, and the ingress."""
+    for actor_name in ("_serve_http", CONTROLLER_NAME):
+        try:
+            a = ray_tpu.get_actor(actor_name)
+            if actor_name == CONTROLLER_NAME:
+                try:
+                    ray_tpu.get(a.shutdown.remote(), timeout=30)
+                except Exception:
+                    pass
+            ray_tpu.kill(a)
+        except Exception:
+            pass

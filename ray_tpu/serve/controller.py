@@ -1,0 +1,252 @@
+"""Serve controller: reconciles declared deployments to replica actors.
+
+Reference analogs: ServeController (serve/controller.py:64),
+DeploymentState/DeploymentStateManager replica lifecycle
+(_private/deployment_state.py:959,1769), BasicAutoscalingPolicy on queue
+metrics (_private/autoscaling_policy.py:93).
+
+The controller is a detached async actor.  A reconcile loop drives each
+deployment's replica set toward its target count, probes replica health,
+replaces dead replicas, and (when autoscaling is configured) adjusts the
+target from the replicas' reported queue depths — scale-up when the mean
+outstanding queue exceeds the target, scale-down when it falls well below.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "_serve_controller"
+RECONCILE_PERIOD_S = 0.5
+
+
+@dataclasses.dataclass
+class DeploymentSpec:
+    name: str
+    callable_blob: bytes          # cloudpickle (cls_or_fn, args, kwargs)
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    route_prefix: str = ""
+    resources: Optional[Dict[str, float]] = None
+    num_cpus: float = 1.0
+    autoscaling: Optional[Dict[str, Any]] = None  # min/max_replicas,
+    #                                              target_queue_len
+
+
+class Replica:
+    """Actor body hosting one deployment replica."""
+
+    def __init__(self, callable_blob: bytes, max_concurrent_queries: int = 8):
+        import cloudpickle
+        target, args, kwargs = cloudpickle.loads(callable_blob)
+        if isinstance(target, type):
+            self._fn = target(*args, **kwargs)
+        else:
+            self._fn = target
+        self._outstanding = 0
+        # Concurrency is bounded HERE, not by the actor's max_concurrency:
+        # requests waiting on an actor-level semaphore would be invisible to
+        # queue_len, capping the autoscaler's signal at the concurrency
+        # limit no matter how deep the real backlog is.
+        self._sem = asyncio.Semaphore(max_concurrent_queries)
+
+    async def handle_request(self, args, kwargs, method: Optional[str] = None):
+        import functools
+        import inspect
+        self._outstanding += 1
+        try:
+            async with self._sem:
+                fn = self._fn if method is None else getattr(self._fn, method)
+                # Resolve a class instance to its bound __call__ so
+                # coroutine detection sees the real function.
+                if (not inspect.isfunction(fn) and not inspect.ismethod(fn)
+                        and callable(fn) and hasattr(fn, "__call__")):
+                    fn = fn.__call__
+                if asyncio.iscoroutinefunction(fn):
+                    result = await fn(*args, **kwargs)
+                else:
+                    # Sync handlers must not block the replica's event loop:
+                    # run them on threads; self._sem bounds the fan-out.
+                    result = \
+                        await asyncio.get_running_loop().run_in_executor(
+                            None, functools.partial(fn, *args, **kwargs))
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                return result
+        finally:
+            self._outstanding -= 1
+
+    def queue_len(self) -> int:
+        return self._outstanding
+
+    def ping(self) -> bool:
+        return True
+
+
+class ServeController:
+    def __init__(self):
+        self.deployments: Dict[str, DeploymentSpec] = {}
+        self.replicas: Dict[str, List] = {}        # name -> actor handles
+        self.targets: Dict[str, int] = {}          # name -> target count
+        self._replica_seq = 0
+        self._shutdown = False
+        self._loop_task = None
+        self._metrics: Dict[str, List[float]] = {}  # queue-len history
+        # deploy() and the background loop both reconcile; without this
+        # lock a concurrent `reps[:] = alive` clobbers (and orphans)
+        # replicas the other invocation just created.
+        self._reconcile_lock = asyncio.Lock()
+
+    async def _ensure_loop(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._reconcile_loop())
+
+    async def deploy(self, spec: DeploymentSpec) -> bool:
+        """Create or update a deployment (idempotent goal-state write)."""
+        await self._ensure_loop()
+        self.deployments[spec.name] = spec
+        self.targets[spec.name] = spec.num_replicas
+        if spec.autoscaling:
+            lo = spec.autoscaling.get("min_replicas", 1)
+            hi = spec.autoscaling.get("max_replicas", spec.num_replicas)
+            self.targets[spec.name] = min(max(spec.num_replicas, lo), hi)
+        self.replicas.setdefault(spec.name, [])
+        await self._reconcile_once()
+        return True
+
+    async def _kill_replica(self, handle):
+        """Async kill: the blocking ray_tpu.kill would deadlock the actor
+        loop this controller runs on."""
+        from ray_tpu._private.worker import get_core
+        try:
+            await get_core().gcs.request({"type": "kill_actor",
+                                          "actor_id": handle._actor_id,
+                                          "no_restart": True})
+        except Exception:
+            pass
+
+    async def delete_deployment(self, name: str) -> bool:
+        self.deployments.pop(name, None)
+        self.targets.pop(name, None)
+        for r in self.replicas.pop(name, []):
+            await self._kill_replica(r)
+        return True
+
+    async def status(self) -> Dict[str, Any]:
+        return {
+            name: {
+                "target": self.targets.get(name, 0),
+                "running": len(self.replicas.get(name, [])),
+                "route_prefix": spec.route_prefix,
+            }
+            for name, spec in self.deployments.items()
+        }
+
+    async def get_replicas(self, name: str) -> List:
+        """Replica handles for the router (cached client-side)."""
+        return list(self.replicas.get(name, []))
+
+    async def routes(self) -> Dict[str, str]:
+        """route_prefix -> deployment name (for the HTTP ingress)."""
+        return {spec.route_prefix: name
+                for name, spec in self.deployments.items()
+                if spec.route_prefix}
+
+    async def shutdown(self) -> bool:
+        self._shutdown = True
+        for name in list(self.deployments):
+            await self.delete_deployment(name)
+        return True
+
+    # ------------------------------------------------------------ internals
+
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+                await self._autoscale()
+            except Exception:
+                logger.exception("serve reconcile failed")
+            await asyncio.sleep(RECONCILE_PERIOD_S)
+
+    async def _reconcile_once(self):
+        from ray_tpu._private.worker import get_core
+        from ray_tpu.actor import ActorHandle
+
+        async def probe(r):
+            try:
+                # ObjectRef is awaitable; wait_for wraps it.
+                await asyncio.wait_for(r.ping.remote(), timeout=10)
+                return True
+            except Exception:
+                return False
+
+        async with self._reconcile_lock:
+            for name, spec in list(self.deployments.items()):
+                reps = self.replicas.setdefault(name, [])
+                target = self.targets.get(name, spec.num_replicas)
+                # Probe health in parallel; kill-and-replace failures (a
+                # merely dropped replica would keep running and leak its
+                # resource reservation).
+                oks = await asyncio.gather(*[probe(r) for r in reps])
+                for r, ok in zip(list(reps), oks):
+                    if not ok:
+                        logger.warning("serve: replica of %s unhealthy, "
+                                       "replacing", name)
+                        await self._kill_replica(r)
+                reps[:] = [r for r, ok in zip(reps, oks) if ok]
+                while len(reps) < target:
+                    self._replica_seq += 1
+                    resources = {"CPU": spec.num_cpus,
+                                 **(spec.resources or {})}
+                    # max_concurrency has headroom over the request bound:
+                    # requests queue inside the replica (visible to
+                    # queue_len) instead of at the actor layer.
+                    actor_id = await get_core().create_actor_async(
+                        Replica,
+                        (spec.callable_blob, spec.max_concurrent_queries),
+                        {},
+                        resources=resources,
+                        max_concurrency=4 * spec.max_concurrent_queries + 8,
+                        name=f"_serve:{name}:{self._replica_seq}")
+                    reps.append(ActorHandle(actor_id, "Replica"))
+                while len(reps) > target:
+                    await self._kill_replica(reps.pop())
+
+    async def _autoscale(self):
+        """Queue-depth autoscaling (reference: autoscaling_policy.py:93)."""
+        for name, spec in list(self.deployments.items()):
+            cfg = spec.autoscaling
+            reps = self.replicas.get(name, [])
+            if not cfg or not reps:
+                continue
+            try:
+                qs = await asyncio.gather(
+                    *[asyncio.wait_for(r.queue_len.remote(), timeout=10)
+                      for r in reps])
+            except Exception:
+                continue
+            mean_q = sum(qs) / len(qs)
+            hist = self._metrics.setdefault(name, [])
+            hist.append(mean_q)
+            del hist[:-5]
+            target_q = cfg.get("target_queue_len", 2.0)
+            lo = cfg.get("min_replicas", 1)
+            hi = cfg.get("max_replicas", spec.num_replicas)
+            cur = self.targets.get(name, len(reps))
+            smoothed = sum(hist) / len(hist)
+            if smoothed > target_q and cur < hi:
+                self.targets[name] = min(hi, cur + 1)
+                logger.info("serve: scaling %s up to %d (queue %.1f)",
+                            name, self.targets[name], smoothed)
+            elif smoothed < 0.5 * target_q and cur > lo and len(hist) >= 5:
+                self.targets[name] = max(lo, cur - 1)
+                logger.info("serve: scaling %s down to %d (queue %.1f)",
+                            name, self.targets[name], smoothed)

@@ -1,0 +1,158 @@
+"""HTTP ingress actor: minimal asyncio HTTP/1.1 server routing to replicas.
+
+Reference analog: HTTPProxyActor + LongestPrefixRouter
+(_private/http_proxy.py:387,143).  No aiohttp/starlette in this image, so
+the request loop is a small hand-rolled HTTP/1.1 parser: request line +
+headers + Content-Length body, JSON in/out.
+
+Everything here is async-on-the-actor-loop; sync ray_tpu calls (which block
+on the same loop) are never used — the controller is resolved through an
+async GCS lookup and replicas are called by awaiting their ObjectRefs.
+
+POST /<route_prefix>  body=JSON  ->  result of deployment(body)
+GET  /-/routes                   ->  route table
+GET  /-/healthz                  ->  "ok"
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPIngress:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 namespace: str = "default"):
+        self._host, self._port = host, port
+        self._namespace = namespace
+        self._server = None
+        self._routes: Dict[str, str] = {}
+        self._replicas: Dict[str, list] = {}
+        self._rr = itertools.count()
+        self._ctrl = None
+
+    async def _ensure_started(self):
+        if self._server is not None:
+            return
+        self._server = await asyncio.start_server(
+            self._serve_conn, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        asyncio.get_running_loop().create_task(self._route_refresh_loop())
+
+    async def address(self) -> Tuple[str, int]:
+        await self._ensure_started()
+        return (self._host, self._port)
+
+    async def _controller(self):
+        if self._ctrl is None:
+            from ray_tpu._private.worker import get_core
+            from ray_tpu.actor import ActorHandle
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+            info = await get_core().gcs.request(
+                {"type": "get_named_actor", "name": CONTROLLER_NAME,
+                 "namespace": self._namespace})
+            if info is None:
+                raise RuntimeError("serve controller not running")
+            self._ctrl = ActorHandle(info["actor_id"], "ServeController")
+        return self._ctrl
+
+    async def _route_refresh_loop(self):
+        while True:
+            try:
+                ctrl = await self._controller()
+                self._routes = await ctrl.routes.remote()
+                for name in set(self._routes.values()):
+                    self._replicas[name] = \
+                        await ctrl.get_replicas.remote(name)
+            except Exception:
+                self._ctrl = None  # controller restarted; re-resolve
+            await asyncio.sleep(1.0)
+
+    async def _call(self, name: str, payload):
+        reps = self._replicas.get(name)
+        if not reps:
+            ctrl = await self._controller()
+            reps = self._replicas[name] = \
+                await ctrl.get_replicas.remote(name)
+        if not reps:
+            raise RuntimeError(f"deployment {name} has no replicas")
+        replica = reps[next(self._rr) % len(reps)]
+        return await replica.handle_request.remote([payload], {}, None)
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, path, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    return await self._respond(writer, 400,
+                                               {"error": "bad request"})
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                keep = headers.get("connection", "").lower() != "close"
+                await self._dispatch(writer, method, path, body)
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, method: str, path: str, body: bytes):
+        if path == "/-/healthz":
+            return await self._respond(writer, 200, "ok")
+        if path == "/-/routes":
+            return await self._respond(writer, 200, self._routes)
+        # Longest matching route prefix wins (http_proxy.py:143).
+        target: Optional[str] = None
+        best = -1
+        for prefix, name in self._routes.items():
+            if path.startswith(prefix) and len(prefix) > best:
+                target, best = name, len(prefix)
+        if target is None:
+            return await self._respond(writer, 404,
+                                       {"error": f"no route for {path}"})
+        try:
+            payload = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            payload = body.decode("utf-8", "replace")
+        try:
+            result = await self._call(target, payload)
+            await self._respond(writer, 200, {"result": result})
+        except Exception as e:  # noqa: BLE001
+            logger.exception("serve http: request to %s failed", target)
+            await self._respond(writer, 500, {"error": repr(e)})
+
+    async def _respond(self, writer, code: int, payload):
+        if isinstance(payload, str):
+            data = payload.encode()
+            ctype = "text/plain"
+        else:
+            data = json.dumps(payload, default=repr).encode()
+            ctype = "application/json"
+        writer.write(
+            f"HTTP/1.1 {code} {'OK' if code == 200 else 'ERR'}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(data)}\r\n\r\n".encode() + data)
+        await writer.drain()

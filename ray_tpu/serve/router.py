@@ -1,0 +1,78 @@
+"""Client-side router: queue-aware replica choice.
+
+Reference analog: Router/ReplicaSet (_private/router.py:261,62) — requests
+are assigned client-side to the replica with the fewest locally-tracked
+outstanding requests among two random candidates (power-of-two-choices),
+with the replica set cached and refreshed from the controller.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List
+
+import ray_tpu
+
+REFRESH_PERIOD_S = 1.0
+
+
+class DeploymentHandle:
+    """Callable handle to a deployment: ``handle.remote(*args)``."""
+
+    def __init__(self, name: str, controller):
+        self._name = name
+        self._controller = controller
+        self._replicas: List = []
+        self._outstanding: Dict[str, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < REFRESH_PERIOD_S:
+            return
+        reps = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name))
+        with self._lock:
+            self._replicas = reps
+            self._last_refresh = now
+            # Counters reset each refresh window: they only need to skew
+            # the power-of-two choice within the window, and resetting
+            # makes lost decrements self-healing.
+            self._outstanding = {}
+
+    def _pick(self):
+        with self._lock:
+            reps = list(self._replicas)
+        if not reps:
+            raise RuntimeError(
+                f"deployment {self._name} has no running replicas")
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        na = self._outstanding.get(a._actor_id, 0)
+        nb = self._outstanding.get(b._actor_id, 0)
+        return a if na <= nb else b
+
+    def remote(self, *args, _method: str = None, **kwargs):
+        """Route one request; returns an ObjectRef of the result."""
+        self._refresh()
+        replica = self._pick()
+        aid = replica._actor_id
+        with self._lock:
+            # In-flight estimate; reset wholesale on each refresh rather
+            # than tracking completions (which would cost a deserialization
+            # per reply just to decrement a heuristic counter).
+            self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
+        return replica.handle_request.remote(list(args), kwargs, _method)
+
+    def method(self, name: str):
+        """handle.method("encode").remote(...) calls a named method."""
+        h = self
+        class _M:  # noqa: N801 - tiny adapter
+            def remote(self, *a, **k):
+                return h.remote(*a, _method=name, **k)
+        return _M()
+

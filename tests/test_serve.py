@@ -1,0 +1,224 @@
+"""Serve: controller reconciliation, routing, batching, HTTP ingress.
+
+Reference analogs: python/ray/serve/tests/ (test_deploy, test_batching,
+test_autoscaling_policy, test_standalone http).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=16, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_deploy_and_call(serve_cluster):
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    h = serve.run(Doubler.bind())
+    results = ray_tpu.get([h.remote(i) for i in range(20)])
+    assert results == [2 * i for i in range(20)]
+    st = serve.status()
+    assert st["Doubler"]["running"] == 2
+
+
+def test_function_deployment_and_methods(serve_cluster):
+    @serve.deployment(ray_actor_options={"num_cpus": 0.1})
+    class Calc:
+        def __call__(self, x):
+            return x + 1
+
+        def square(self, x):
+            return x * x
+
+    h = serve.run(Calc.bind())
+    assert ray_tpu.get(h.remote(41)) == 42
+    assert ray_tpu.get(h.method("square").remote(7)) == 49
+
+
+def test_scale_up_down(serve_cluster):
+    @serve.deployment(num_replicas=1, ray_actor_options={"num_cpus": 0.1})
+    class S:
+        def __call__(self, x):
+            return x
+
+    serve.run(S.bind())
+    assert serve.status()["S"]["running"] == 1
+    serve.run(S.options(num_replicas=3).bind())
+    assert serve.status()["S"]["running"] == 3
+    serve.run(S.options(num_replicas=1).bind())
+    deadline = time.monotonic() + 30
+    while serve.status()["S"]["running"] != 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.3)
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(max_concurrent_queries=16,
+                      ray_actor_options={"num_cpus": 0.1})
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batched.bind())
+    refs = [h.remote(i) for i in range(16)]
+    assert sorted(ray_tpu.get(refs)) == [i * 10 for i in range(16)]
+    sizes = ray_tpu.get(h.method("sizes").remote())
+    assert sum(sizes) == 16
+    # Concurrent submission must have produced at least one real batch.
+    assert max(sizes) > 1, sizes
+
+
+def test_replica_recovery(serve_cluster):
+    """Controller replaces a killed replica (deployment_state reconcile)."""
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+    class R:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(R.bind())
+    # Kill one replica out from under the controller.
+    victim = ray_tpu.get(
+        serve._controller().get_replicas.remote("R"))[0]
+    ray_tpu.kill(victim)
+    deadline = time.monotonic() + 60
+    while True:
+        st = serve.status()["R"]
+        reps = ray_tpu.get(serve._controller().get_replicas.remote("R"))
+        live = 0
+        for r in reps:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=5)
+                live += 1
+            except Exception:
+                pass
+        if live == 2:
+            break
+        assert time.monotonic() < deadline, "replica never replaced"
+        time.sleep(0.5)
+    assert ray_tpu.get(h.remote(5)) == 5
+
+
+def test_http_ingress(serve_cluster):
+    @serve.deployment(route_prefix="/echo",
+                      ray_actor_options={"num_cpus": 0.1})
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind())
+    base = serve.start_http()
+    # Routes propagate via the ingress refresh loop.
+    deadline = time.monotonic() + 30
+    while True:
+        with urllib.request.urlopen(f"{base}/-/routes", timeout=10) as r:
+            routes = json.loads(r.read())
+        if "/echo" in routes:
+            break
+        assert time.monotonic() < deadline
+        time.sleep(0.3)
+
+    req = urllib.request.Request(
+        f"{base}/echo", data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out == {"result": {"echo": {"x": 1}}}
+
+    with urllib.request.urlopen(f"{base}/-/healthz", timeout=10) as r:
+        assert r.read() == b"ok"
+
+
+@pytest.mark.slow
+def test_jitted_model_deployment(serve_cluster):
+    """VERDICT criterion: deploy a jitted GPT forward and sustain
+    concurrent requests."""
+    @serve.deployment(num_replicas=1, max_concurrent_queries=8,
+                      ray_actor_options={"num_cpus": 1})
+    class GPTServer:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+            from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init
+            self.cfg = GPTConfig.tiny()
+            self.params = gpt_init(jax.random.PRNGKey(0), self.cfg)
+            self.fwd = jax.jit(
+                lambda p, t: gpt_forward(p, t, self.cfg))
+            self.jnp = jnp
+            # Warm the compile cache so requests measure steady state.
+            self.fwd(self.params,
+                     jnp.ones((1, 16), jnp.int32)).block_until_ready()
+
+        def __call__(self, token_list):
+            toks = self.jnp.asarray([token_list], self.jnp.int32)
+            logits = self.fwd(self.params, toks)
+            return [float(x) for x in logits[0, -1, :4]]
+
+    h = serve.run(GPTServer.bind())
+    tokens = list(range(16))
+    refs = [h.remote(tokens) for _ in range(12)]
+    outs = ray_tpu.get(refs, timeout=300)
+    assert all(len(o) == 4 for o in outs)
+    # Deterministic forward: every request sees identical logits.
+    assert all(o == outs[0] for o in outs)
+    serve.delete("GPTServer")
+
+
+@pytest.mark.slow
+def test_autoscaling_up(serve_cluster):
+    import threading
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=4,
+                      ray_actor_options={"num_cpus": 0.1},
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_queue_len": 1.0})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    h = serve.run(Slow.bind())
+    stop = threading.Event()
+
+    def flood():
+        while not stop.is_set():
+            try:
+                ray_tpu.get([h.remote(i) for i in range(8)], timeout=60)
+            except Exception:
+                return
+
+    t = threading.Thread(target=flood, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 90
+        while serve.status()["Slow"]["running"] < 2:
+            assert time.monotonic() < deadline, "never scaled up"
+            time.sleep(0.5)
+    finally:
+        stop.set()
+        t.join(timeout=30)
