@@ -19,15 +19,18 @@ from typing import Any, Callable, List, Optional
 def batch(fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01):
     def wrap(func):
-        queues: dict = {}  # instance id -> _BatchQueue (per replica)
+        attr = f"__serve_batch_queue_{func.__name__}"
 
         @functools.wraps(func)
         async def caller(self, item):
-            q = queues.get(id(self))
+            # The queue lives on the instance (not a closure dict keyed by
+            # id(self)): it dies with the instance and can't be handed to a
+            # different object on CPython id reuse.
+            q = getattr(self, attr, None)
             if q is None:
-                q = queues[id(self)] = _BatchQueue(
-                    lambda items: func(self, items),
-                    max_batch_size, batch_wait_timeout_s)
+                q = _BatchQueue(lambda items: func(self, items),
+                                max_batch_size, batch_wait_timeout_s)
+                setattr(self, attr, q)
             return await q.submit(item)
 
         caller._is_serve_batch = True

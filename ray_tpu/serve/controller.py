@@ -109,8 +109,19 @@ class ServeController:
                 self._reconcile_loop())
 
     async def deploy(self, spec: DeploymentSpec) -> bool:
-        """Create or update a deployment (idempotent goal-state write)."""
+        """Create or update a deployment (idempotent goal-state write).
+
+        A changed callable/config replaces every existing replica — old
+        replicas would otherwise keep serving the old code forever (the
+        reference rolls replicas on version change,
+        deployment_state.py:959)."""
         await self._ensure_loop()
+        old = self.deployments.get(spec.name)
+        code_changed = old is not None and (
+            old.callable_blob != spec.callable_blob or
+            old.max_concurrent_queries != spec.max_concurrent_queries or
+            old.num_cpus != spec.num_cpus or
+            old.resources != spec.resources)
         self.deployments[spec.name] = spec
         self.targets[spec.name] = spec.num_replicas
         if spec.autoscaling:
@@ -118,6 +129,11 @@ class ServeController:
             hi = spec.autoscaling.get("max_replicas", spec.num_replicas)
             self.targets[spec.name] = min(max(spec.num_replicas, lo), hi)
         self.replicas.setdefault(spec.name, [])
+        if code_changed:
+            async with self._reconcile_lock:
+                for r in self.replicas.get(spec.name, []):
+                    await self._kill_replica(r)
+                self.replicas[spec.name] = []
         await self._reconcile_once()
         return True
 
@@ -133,10 +149,14 @@ class ServeController:
             pass
 
     async def delete_deployment(self, name: str) -> bool:
-        self.deployments.pop(name, None)
-        self.targets.pop(name, None)
-        for r in self.replicas.pop(name, []):
-            await self._kill_replica(r)
+        # Under the reconcile lock: an in-flight reconcile that already
+        # snapshotted this deployment would otherwise recreate (and orphan)
+        # replicas right after we kill them.
+        async with self._reconcile_lock:
+            self.deployments.pop(name, None)
+            self.targets.pop(name, None)
+            for r in self.replicas.pop(name, []):
+                await self._kill_replica(r)
         return True
 
     async def status(self) -> Dict[str, Any]:

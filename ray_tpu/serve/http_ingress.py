@@ -120,16 +120,20 @@ class HTTPIngress:
                 pass
 
     async def _dispatch(self, writer, method: str, path: str, body: bytes):
+        path = path.split("?", 1)[0]  # health checks may append queries
         if path == "/-/healthz":
             return await self._respond(writer, 200, "ok")
         if path == "/-/routes":
             return await self._respond(writer, 200, self._routes)
-        # Longest matching route prefix wins (http_proxy.py:143).
+        # Longest matching route prefix wins, on path-segment boundaries
+        # (http_proxy.py:143 LongestPrefixRouter): /echo matches /echo and
+        # /echo/x but not /echoes.
         target: Optional[str] = None
         best = -1
         for prefix, name in self._routes.items():
-            if path.startswith(prefix) and len(prefix) > best:
-                target, best = name, len(prefix)
+            p = prefix.rstrip("/")
+            if (path == p or path.startswith(p + "/")) and len(p) > best:
+                target, best = name, len(p)
         if target is None:
             return await self._respond(writer, 404,
                                        {"error": f"no route for {path}"})
