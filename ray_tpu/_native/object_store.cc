@@ -18,14 +18,17 @@
 //
 // Exposed as a C ABI consumed from Python via ctypes (no pybind11 in image).
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+#include <vector>
 
 namespace {
 
@@ -52,6 +55,14 @@ struct Entry {
   uint32_t pad;
   uint64_t lru_prev;  // Entry index or kNil
   uint64_t lru_next;
+  // Crash-reclaim bookkeeping: the creator (while unsealed) and the most
+  // recent pinner.  EOWNERDEAD recovery frees unsealed entries whose
+  // creator died and unpins entries whose last pinner died — without this,
+  // every worker killed mid-operation permanently leaks its memory.
+  // (Single-pid tracking is approximate for multi-pinner objects; the
+  // rare mis-unpin degrades to an eviction-under-reader, not a crash.)
+  int32_t creator_pid;
+  int32_t pinner_pid;
 };
 
 struct StoreHeader {
@@ -95,11 +106,18 @@ inline uint64_t hash_id(const uint8_t* id) {
   return v;
 }
 
+void rebuild_from_table(Handle* h);
+
 void lock(Handle* h) {
   int rc = pthread_mutex_lock(&h->hdr->mutex);
   if (rc == EOWNERDEAD) {
-    // A process died holding the lock; state is best-effort consistent
-    // (operations are short and idempotent enough for recovery).
+    // A process died while holding the lock (workers are SIGTERM'd as part
+    // of normal actor teardown, so this is routine, not exceptional).  The
+    // allocator block chain and LRU list may be half-updated; walking them
+    // as-is can cycle forever WITH THE LOCK HELD, freezing every process
+    // on the host.  The entry table is the source of truth -- rebuild the
+    // derived structures from it before continuing.
+    rebuild_from_table(h);
     pthread_mutex_consistent(&h->hdr->mutex);
   }
 }
@@ -185,11 +203,116 @@ inline BlockHeader* prev_block(Handle* h, BlockHeader* b) {
                                         b->prev_size - sizeof(BlockHeader));
 }
 
+// Rebuild the allocator block chain and LRU list from the entry table
+// (called on robust-mutex EOWNERDEAD recovery: the table is the source of
+// truth; the derived structures may be half-updated by the dead process).
+// Entries whose extents are implausible are tombstoned -- losing an object
+// is survivable (owners reconstruct from lineage / re-pull), a corrupted
+// allocator freezes the whole host.
+bool pid_dead(int32_t pid) {
+  return pid > 0 && kill(pid, 0) != 0 && errno == ESRCH;
+}
+
+void rebuild_from_table(Handle* h) {
+  const uint64_t cap = h->hdr->capacity;
+  std::vector<Entry*> live;
+  for (uint64_t i = 0; i < h->hdr->num_slots; ++i) {
+    Entry* e = &h->table[i];
+    if (e->state != 1 && e->state != 2) continue;
+    uint64_t payload = align_up(e->size < 8 ? 8 : e->size, kAlign);
+    // Overflow-safe extent check (subtraction form): a scribbled
+    // offset/size must not wrap past cap and drive a wild write below.
+    if (payload < e->size || e->offset < sizeof(BlockHeader) ||
+        payload > cap || e->offset > cap - payload) {
+      e->state = 3;  // implausible extent: drop
+      continue;
+    }
+    // Reclaim crash leftovers: unsealed creations of dead processes can
+    // never be sealed, and pins of dead processes can never be released.
+    if (e->state == 1 && pid_dead(e->creator_pid)) {
+      e->state = 3;
+      continue;
+    }
+    if (e->refcount > 0 && pid_dead(e->pinner_pid)) {
+      e->refcount = 0;
+      e->pinner_pid = 0;
+    }
+    live.push_back(e);
+  }
+  std::sort(live.begin(), live.end(),
+            [](Entry* a, Entry* b) { return a->offset < b->offset; });
+
+  h->hdr->lru_head = h->hdr->lru_tail = kNil;
+  uint64_t pos = 0;  // next unassigned byte in the data region
+  uint64_t prev_payload = 0;
+  uint64_t bytes_used = 0, num_objects = 0;
+  BlockHeader* prev_alloc = nullptr;
+  for (Entry* e : live) {
+    uint64_t payload = align_up(e->size < 8 ? 8 : e->size, kAlign);
+    uint64_t bstart = e->offset - sizeof(BlockHeader);
+    if (bstart < pos) {  // overlaps the previous block: drop
+      e->state = 3;
+      continue;
+    }
+    uint64_t gap = bstart - pos;
+    if (gap >= sizeof(BlockHeader)) {
+      BlockHeader* fb = reinterpret_cast<BlockHeader*>(h->data + pos);
+      fb->size = gap - sizeof(BlockHeader);
+      fb->prev_size = prev_payload;
+      fb->free_flag = 1;
+      fb->last_flag = 0;
+      prev_payload = fb->size;
+    } else if (gap > 0) {
+      // Sub-header sliver: fold it into the previous block's payload.
+      if (prev_alloc != nullptr) {
+        prev_alloc->size += gap;
+        prev_payload = prev_alloc->size;
+      } else {
+        e->state = 3;  // sliver at region start: unrecoverable, drop
+        continue;
+      }
+    }
+    BlockHeader* b = reinterpret_cast<BlockHeader*>(h->data + bstart);
+    b->size = payload;
+    b->prev_size = prev_payload;
+    b->free_flag = 0;
+    b->last_flag = 0;
+    prev_payload = payload;
+    prev_alloc = b;
+    pos = bstart + sizeof(BlockHeader) + payload;
+    bytes_used += e->size;
+    num_objects += 1;
+    e->lru_prev = e->lru_next = kNil;
+    if (e->state == 2 && e->refcount == 0) lru_push_tail(h, e);
+  }
+  // Trailing free block (or the whole region when empty).
+  if (pos + sizeof(BlockHeader) <= cap) {
+    BlockHeader* fb = reinterpret_cast<BlockHeader*>(h->data + pos);
+    fb->size = cap - pos - sizeof(BlockHeader);
+    fb->prev_size = prev_payload;
+    fb->free_flag = 1;
+    fb->last_flag = 1;
+  } else if (prev_alloc != nullptr) {
+    prev_alloc->size += cap - pos;  // absorb the tail sliver
+    prev_alloc->last_flag = 1;
+  }
+  h->hdr->bytes_used = bytes_used;
+  h->hdr->num_objects = num_objects;
+}
+
 // Returns payload offset into data region, or kNil if no fit.
 uint64_t alloc_block(Handle* h, uint64_t want) {
   want = align_up(want < 8 ? 8 : want, kAlign);
+  // Bounded walk: a corrupted chain (sizes cycling) must degrade to an
+  // allocation failure, never an infinite loop under the store lock.
+  uint64_t steps = 0;
+  const uint64_t max_steps = h->hdr->capacity / kAlign + 2;
   BlockHeader* b = reinterpret_cast<BlockHeader*>(h->data);
   while (b) {
+    if (++steps > max_steps) {
+      rebuild_from_table(h);
+      return kNil;
+    }
     if (b->free_flag && b->size >= want) {
       // Split if the remainder can hold a header + a minimal payload.
       if (b->size >= want + sizeof(BlockHeader) + kAlign) {
@@ -240,7 +363,13 @@ void free_block(Handle* h, uint64_t payload_off) {
 // Evict LRU objects until `want` bytes could plausibly fit; returns number evicted.
 int evict_for(Handle* h, uint64_t want) {
   int evicted = 0;
+  uint64_t steps = 0;
   while (h->hdr->lru_head != kNil) {
+    if (++steps > h->hdr->num_slots + 1 ||      // cycle guard
+        h->hdr->lru_head >= h->hdr->num_slots) {  // bogus index guard
+      rebuild_from_table(h);
+      return evicted;
+    }
     uint64_t off = alloc_block(h, want);
     if (off != kNil) {
       // Undo the probe allocation; caller will re-run alloc_block.
@@ -382,6 +511,8 @@ int store_create_object(void* hv, const uint8_t* id, uint64_t size, uint64_t* of
   e->size = size;
   e->state = 1;
   e->refcount = 1;  // creator holds a ref until seal+release
+  e->creator_pid = static_cast<int32_t>(getpid());
+  e->pinner_pid = e->creator_pid;
   h->hdr->bytes_used += size;
   h->hdr->num_objects += 1;
   *offset_out = off;
@@ -416,6 +547,7 @@ int store_get(void* hv, const uint8_t* id, uint64_t* offset_out, uint64_t* size_
   }
   if (e->refcount == 0) lru_remove(h, e);
   e->refcount += 1;
+  e->pinner_pid = static_cast<int32_t>(getpid());
   *offset_out = e->offset;
   *size_out = e->size;
   unlock(h);
@@ -469,6 +601,17 @@ int store_contains(void* hv, const uint8_t* id) {
 void* store_pointer(void* hv, uint64_t offset) {
   Handle* h = static_cast<Handle*>(hv);
   return h->data + offset;
+}
+
+// TEST-ONLY: simulate a process dying mid-operation while holding the store
+// lock, leaving derived state corrupted.  Exercises the EOWNERDEAD recovery
+// path (rebuild_from_table) deterministically; never called in production.
+void store_test_die_holding_lock(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  pthread_mutex_lock(&h->hdr->mutex);
+  h->hdr->lru_head = h->hdr->num_slots + 12345;  // bogus index
+  h->hdr->lru_tail = 7;
+  _exit(0);  // dies with the robust mutex held
 }
 
 uint64_t store_capacity(void* hv) { return static_cast<Handle*>(hv)->hdr->capacity; }

@@ -671,10 +671,11 @@ class CoreWorker:
         }
         scheduling = scheduling or {}
         resources = dict(resources or {"CPU": 1.0})
-        asyncio.run_coroutine_threadsafe(
-            self._submit_and_track(spec, resources, scheduling, max_retries,
-                                   retry_exceptions, return_ids, pinned_args),
-            self.loop)
+        # Ownership/lineage registration MUST precede scheduling the
+        # submission: _store_task_returns drops results for unowned ids
+        # (freed-while-running), and on a contended box the task can finish
+        # before this thread runs again — registering late would lose the
+        # result and hang the eventual get() forever.
         for oid in return_ids:
             self.owned.add(oid.hex())
             # Lineage: the producing task's spec, kept while we own the
@@ -687,6 +688,10 @@ class CoreWorker:
                 "scheduling": scheduling, "return_ids": return_ids,
                 "pins": pinned_args,
             }
+        asyncio.run_coroutine_threadsafe(
+            self._submit_and_track(spec, resources, scheduling, max_retries,
+                                   retry_exceptions, return_ids, pinned_args),
+            self.loop)
         return refs
 
     async def _submit_and_track(self, spec, resources, scheduling, max_retries,
@@ -720,6 +725,8 @@ class CoreWorker:
             self._store_local(oid.hex(), "err", payload)
 
     async def _submit_once(self, spec, resources, scheduling) -> dict:
+        logger.debug("task %s %s: leasing", spec["task_id"][:8],
+                     spec["name"])
         raylet = self.raylet
         lease_msg = {"type": "lease_worker", "resources": resources}
         if scheduling.get("placement_group_id"):
@@ -751,8 +758,13 @@ class CoreWorker:
         lease_raylet = raylet
         crashed = False
         try:
-            return await worker_conn.request(
+            logger.debug("task %s: pushing to %s", spec["task_id"][:8],
+                         grant["worker_address"])
+            reply = await worker_conn.request(
                 {"type": "push_task", "spec": spec}, timeout=None)
+            logger.debug("task %s: reply ok=%s", spec["task_id"][:8],
+                         reply.get("ok"))
+            return reply
         except ConnectionLost:
             crashed = True
             raise

@@ -113,6 +113,8 @@ class Raylet:
             self._heartbeat_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._reap_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._stuck_lease_watchdog()))
         return port
 
     async def close(self):
@@ -133,6 +135,20 @@ class Raylet:
         if self.gcs_conn:
             await self.gcs_conn.close()
         self.plasma.close()
+
+    async def _stuck_lease_watchdog(self):
+        """Log scheduler state while leases sit queued — a queued lease
+        with idle capacity means resource accounting has leaked."""
+        while not self._shutdown:
+            await asyncio.sleep(20)
+            if self.pending_leases:
+                busy = sum(1 for w in self.workers.values() if w.busy)
+                logger.warning(
+                    "raylet: %d leases pending; available=%s busy_workers=%d "
+                    "idle=%d total_workers=%d wants=%s",
+                    len(self.pending_leases), self.resources_available,
+                    busy, len(self.idle_workers), len(self.workers),
+                    [r.resources for r in self.pending_leases[:4]])
 
     async def _heartbeat_loop(self):
         while not self._shutdown:

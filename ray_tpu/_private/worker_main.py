@@ -60,6 +60,8 @@ class TaskExecutor:
     # -- normal tasks --
 
     async def _execute_task(self, spec: dict) -> dict:
+        logger.debug("exec task %s %s: start", spec["task_id"][:8],
+                     spec.get("name"))
         try:
             fn = await self.core.load_function(spec["fid"])
             args, kwargs = await self.core.resolve_args(spec["args"],
@@ -70,6 +72,7 @@ class TaskExecutor:
             # Borrow registrations must reach owners before the reply
             # releases the submitter's arg pins.
             await self.core.flush_borrow_acks()
+            logger.debug("exec task %s: done", spec["task_id"][:8])
             return self._pack_returns(spec, result)
         except SystemExit as e:
             asyncio.get_running_loop().call_later(0.2, os._exit,

@@ -118,6 +118,14 @@ def _merge_sorted(key, descending, *blocks):
     return merged
 
 
+def _fused_stages(stages, block):
+    """Run a chain of lazy stages as ONE task (reference: _internal/plan.py
+    stage fusion — N map stages cost one task per block, not N)."""
+    for kernel, fn, extra in stages:
+        block = kernel(fn, block, *extra)
+    return block
+
+
 class ActorPoolStrategy:
     """compute= strategy running stages on a pool of reusable actors
     (reference _internal/compute.py:179)."""
@@ -134,14 +142,37 @@ class _StageActor:
 
 
 class Dataset:
+    """Lazy by default: map/filter/flat_map/map_batches append stages to a
+    plan; consumption (iter_*, count, split, ...) executes it with all
+    consecutive task stages FUSED into one task per block (reference:
+    ExecutionPlan, _internal/plan.py:76).  All-to-all ops (repartition,
+    shuffle, sort, ...) are execution barriers, as upstream."""
+
     def __init__(self, block_refs: List[Any],
-                 metadata: Optional[List[BlockMetadata]] = None):
-        self._blocks = list(block_refs)
-        self._metadata = metadata
+                 metadata: Optional[List[BlockMetadata]] = None,
+                 stages: Optional[List[tuple]] = None):
+        self._input_blocks = list(block_refs)
+        self._stages: List[tuple] = list(stages or [])
+        self._executed: Optional[List[Any]] = \
+            None if self._stages else self._input_blocks
+        self._metadata = metadata if not self._stages else None
+
+    @property
+    def _blocks(self) -> List[Any]:
+        return self._execute()
+
+    def _execute(self) -> List[Any]:
+        if self._executed is None:
+            task = ray_tpu.remote(_fused_stages)
+            stages = list(self._stages)
+            self._executed = [task.remote(stages, b)
+                              for b in self._input_blocks]
+        return self._executed
 
     # -- introspection ----------------------------------------------------
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        return len(self._input_blocks if self._executed is None
+                   else self._executed)
 
     def count(self) -> int:
         return sum(m.num_rows for m in self._meta())
@@ -170,17 +201,23 @@ class Dataset:
     # -- transforms -------------------------------------------------------
     def _run_stage(self, kernel, fn, compute=None, extra=()) -> "Dataset":
         if isinstance(compute, ActorPoolStrategy):
+            # Actor stages execute eagerly (they hold process state, e.g. a
+            # loaded model, so they can't ride the fused-task path).
+            blocks = self._execute()
             pool_cls = ray_tpu.remote(_StageActor)
             pool = [pool_cls.remote()
                     for _ in builtins.range(min(compute.size,
-                                                len(self._blocks)) or 1)]
+                                                len(blocks)) or 1)]
             refs = [pool[i % len(pool)].run.remote(kernel, fn, b, *extra)
-                    for i, b in enumerate(self._blocks)]
+                    for i, b in enumerate(blocks)]
             out = Dataset(refs)
             out._actor_pool = pool  # keep alive until ds collected
             return out
-        task = ray_tpu.remote(kernel)
-        return Dataset([task.remote(fn, b, *extra) for b in self._blocks])
+        # Lazy: append to the plan; fused at execution time.
+        return Dataset(self._input_blocks if self._executed is None
+                       else self._executed,
+                       stages=(self._stages if self._executed is None
+                               else []) + [(kernel, fn, tuple(extra))])
 
     def map(self, fn: Callable, *, compute=None) -> "Dataset":
         return self._run_stage(_map_rows_block, fn, compute)
@@ -368,14 +405,64 @@ class Dataset:
         for ref in self._blocks:
             yield from BlockAccessor(ray_tpu.get(ref)).rows()
 
+    def _iter_resolved_blocks(self, prefetch_blocks: int) -> Iterator[Any]:
+        """Yield materialized blocks, fetching up to `prefetch_blocks`
+        ahead on a background thread so network/store latency overlaps the
+        consumer (reference: block prefetching in iter_batches,
+        dataset.py + _internal torch iterator)."""
+        refs = self._blocks
+        if prefetch_blocks <= 0 or len(refs) <= 1:
+            for ref in refs:
+                yield ray_tpu.get(ref)
+            return
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch_blocks)
+        SENTINEL = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def fetch():
+            try:
+                for ref in refs:
+                    if not _put(("ok", ray_tpu.get(ref))):
+                        return  # consumer abandoned the iterator
+            except BaseException as e:  # surfaced to the consumer
+                _put(("err", e))
+            _put((None, SENTINEL))
+
+        t = threading.Thread(target=fetch, daemon=True,
+                             name="rt-data-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, item = q.get()
+                if item is SENTINEL:
+                    return
+                if kind == "err":
+                    raise item
+                yield item
+        finally:
+            # Generator closed early (break in the consumer loop): release
+            # the fetcher so it doesn't park on a full queue forever.
+            stop.set()
+
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 1) -> Iterator[Any]:
         """Yield host batches sized for device put (the TPU input path:
         numpy batches feed jnp.asarray / device_put inside the step)."""
         carry: Optional[Any] = None
-        for ref in self._blocks:
-            block = ray_tpu.get(ref)
+        for block in self._iter_resolved_blocks(prefetch_blocks):
             if carry is not None:
                 block = _merge_blocks_local([carry, block])
                 carry = None
@@ -389,6 +476,32 @@ class Dataset:
                 carry = acc.slice(full_end, n)
         if carry is not None and not drop_last:
             yield self._format_batch(carry, batch_format)
+
+    def iter_device_batches(self, *, batch_size: int = 256,
+                            sharding=None, drop_last: bool = True,
+                            prefetch_blocks: int = 2) -> Iterator[Any]:
+        """Double-buffered device ingest (SURVEY §7 hard part (d)): yields
+        jax arrays with the NEXT batch's host->device transfer already in
+        flight while the caller's step runs on the current one.  Pass a
+        NamedSharding to land batches pre-sharded across the mesh."""
+        import jax
+
+        def put(batch):
+            if sharding is not None:
+                return jax.device_put(batch, sharding)
+            return jax.device_put(batch)
+
+        prev = None
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last,
+                                       prefetch_blocks=prefetch_blocks):
+            nxt = put(batch)  # async dispatch: copy overlaps consumer step
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
 
     @staticmethod
     def _format_batch(sub, batch_format: str):

@@ -23,13 +23,19 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+if os.environ.get("RT_TEST_LOG_LEVEL"):
+    import logging
+    logging.basicConfig(level=os.environ["RT_TEST_LOG_LEVEL"])
+    logging.getLogger("jax").setLevel(logging.WARNING)
+
 
 @pytest.fixture(scope="module")
 def ray_start():
     """Module-scoped local cluster with 4 CPUs (reference: ray_start_regular)."""
     import ray_tpu
     # Generous CPU count: module-scoped tests accumulate long-lived actors.
-    ray_tpu.init(num_cpus=16, _worker_env={"JAX_PLATFORMS": "cpu"})
+    ray_tpu.init(num_cpus=16, _worker_env={"JAX_PLATFORMS": "cpu"},
+                 log_level=os.environ.get("RT_TEST_LOG_LEVEL", "WARNING"))
     yield
     ray_tpu.shutdown()
 
