@@ -108,3 +108,9 @@ def available_resources() -> Dict[str, float]:
 def nodes() -> List[dict]:
     from ray_tpu._private.worker import get_core
     return get_core().gcs_request({"type": "get_nodes"})
+
+
+def timeline(filename=None):
+    """Chrome-trace JSON of task executions (reference: `ray timeline`)."""
+    from ray_tpu.util.state import timeline as _tl
+    return _tl(filename)

@@ -93,6 +93,10 @@ class CoreWorker:
         self._borrow_acks: list = []            # in-flight borrow_add futures
         self._lineage: Dict[str, dict] = {}     # oid -> producing task record
         self._reconstructing: Dict[str, asyncio.Future] = {}
+        # Task profile events, flushed to the GCS in batches (reference:
+        # TaskEventBuffer, task_event_buffer.h).
+        self._task_events: list = []
+        self._event_flusher_started = False
 
         self.plasma: Optional[PlasmaClient] = None
         if store_name:
@@ -192,6 +196,35 @@ class CoreWorker:
     async def _h_reconstruct_object(self, msg: dict):
         ok = await self._reconstruct(msg["object_id"])
         return {"ok": ok}
+
+    # --------------------------------------------------------- task events
+
+    def record_task_event(self, event: dict):
+        """Buffer a task profile event; flushed to the GCS once a second
+        (feeds the state API and `ray_tpu.timeline`)."""
+        import os as _os
+        event.setdefault("pid", _os.getpid())
+        event.setdefault("node_id", self.node_id_hex)
+        self._task_events.append(event)
+        if not self._event_flusher_started:
+            self._event_flusher_started = True
+            asyncio.run_coroutine_threadsafe(self._flush_events_loop(),
+                                             self.loop)
+
+    async def flush_task_events(self):
+        if not self._task_events:
+            return
+        batch, self._task_events = self._task_events, []
+        try:
+            await self.gcs.request({"type": "task_events",
+                                    "events": batch}, timeout=10)
+        except Exception:
+            pass  # observability is best-effort
+
+    async def _flush_events_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            await self.flush_task_events()
 
     async def _await_in_store(self, oid: str, deadline: float) -> bool:
         """Long-poll until `oid` has a memory-store entry; False on timeout."""

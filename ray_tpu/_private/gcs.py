@@ -130,6 +130,9 @@ class GcsServer:
         # object_id hex -> (owner address, set of node hexes with a copy)
         self.object_dir: Dict[str, Tuple[str, Set[str]]] = {}
         self.subscribers: Dict[str, List[RpcConnection]] = {}
+        from collections import deque
+        self.task_events: "deque" = deque(maxlen=20000)
+        self.metrics: Dict[tuple, dict] = {}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
         self._health_task: Optional[asyncio.Task] = None
@@ -680,6 +683,76 @@ class GcsServer:
     async def _h_subscribe(self, conn, msg):
         self.subscribers.setdefault(msg["channel"], []).append(conn)
         return {"ok": True}
+
+    # ------------------------------------------------- observability
+
+    async def _h_task_events(self, conn, msg):
+        """Batched per-task profile events from executors (reference:
+        TaskEventBuffer -> GcsTaskManager, gcs_task_manager.h:40)."""
+        self.task_events.extend(msg["events"])
+        return {"ok": True}
+
+    async def _h_list_task_events(self, conn, msg):
+        limit = msg.get("limit", 10000)
+        evs = list(self.task_events)
+        return evs[-limit:]
+
+    async def _h_list_objects(self, conn, msg):
+        return [{"object_id": oid, "owner": owner,
+                 "locations": sorted(locs)}
+                for oid, (owner, locs) in self.object_dir.items()]
+
+    async def _h_list_placement_groups(self, conn, msg):
+        return [{"pg_id": pg.pg_id.hex(), "bundles": pg.bundles,
+                 "strategy": pg.strategy,
+                 "allocations": {str(k): v.hex() if hasattr(v, "hex") else v
+                                 for k, v in
+                                 (pg.allocations or {}).items()}}
+                for pg in self.placement_groups.values()]
+
+    async def _h_report_metrics(self, conn, msg):
+        """Per-process metric snapshots (reference: OpenCensus exporter ->
+        metrics agent; util/metrics.py user API).  Stored per
+        (name, labels, pid), stamped with report time, and capped."""
+        now = time.time()
+        for m in msg["metrics"]:
+            key = (m["name"], tuple(sorted(m.get("labels", {}).items())),
+                   msg.get("pid", 0))
+            m["_ts"] = now
+            self.metrics[key] = m
+        if len(self.metrics) > 10000:
+            # Prune the stalest per-process series (dead-pid leftovers).
+            for key in sorted(self.metrics,
+                              key=lambda k: self.metrics[k]["_ts"])[:1000]:
+                del self.metrics[key]
+        return {"ok": True}
+
+    async def _h_list_metrics(self, conn, msg):
+        agg: Dict[tuple, dict] = {}
+        for (name, labels, _pid), m in self.metrics.items():
+            k = (name, labels)
+            cur = agg.get(k)
+            if cur is None:
+                agg[k] = {"name": name, "labels": dict(labels),
+                          "type": m["type"], "value": m["value"],
+                          "buckets": dict(m.get("buckets") or {}),
+                          "_ts": m.get("_ts", 0)}
+            elif m["type"] == "counter":
+                agg[k]["value"] += m["value"]
+            elif m["type"] == "gauge":
+                # Last write wins across processes BY REPORT TIME (dict
+                # order would let a stale, even dead-process value win).
+                if m.get("_ts", 0) >= agg[k]["_ts"]:
+                    agg[k]["value"] = m["value"]
+                    agg[k]["_ts"] = m.get("_ts", 0)
+            elif m["type"] == "histogram":
+                agg[k]["value"] += m["value"]
+                for b, c in (m.get("buckets") or {}).items():
+                    agg[k]["buckets"][b] = agg[k]["buckets"].get(b, 0) + c
+        out = list(agg.values())
+        for m in out:
+            m.pop("_ts", None)
+        return out
 
     # ------------------------------------------------------------- misc
 

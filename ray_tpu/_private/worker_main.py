@@ -21,6 +21,7 @@ import inspect
 import logging
 import os
 import sys
+import time
 import traceback
 
 import cloudpickle
@@ -62,6 +63,8 @@ class TaskExecutor:
     async def _execute_task(self, spec: dict) -> dict:
         logger.debug("exec task %s %s: start", spec["task_id"][:8],
                      spec.get("name"))
+        t0 = time.time()
+        status = "FINISHED"
         try:
             fn = await self.core.load_function(spec["fid"])
             args, kwargs = await self.core.resolve_args(spec["args"],
@@ -75,12 +78,23 @@ class TaskExecutor:
             logger.debug("exec task %s: done", spec["task_id"][:8])
             return self._pack_returns(spec, result)
         except SystemExit as e:
+            status = "FAILED"
+            # Ship buffered task events before dying — the periodic flusher
+            # won't get another tick (its period exceeds the exit grace).
+            asyncio.get_running_loop().create_task(
+                self.core.flush_task_events())
             asyncio.get_running_loop().call_later(0.2, os._exit,
                                                   e.code or 0)
             return {"ok": False, "error": _serialize_exception(
                 RuntimeError("worker exited via SystemExit"))}
         except Exception as e:  # noqa: BLE001
+            status = "FAILED"
             return {"ok": False, "error": _serialize_exception(e)}
+        finally:
+            self.core.record_task_event({
+                "task_id": spec["task_id"], "name": spec.get("name"),
+                "kind": "task", "start": t0, "end": time.time(),
+                "status": status})
 
     def _pack_returns(self, spec: dict, result) -> dict:
         num_returns = spec["num_returns"]
@@ -137,6 +151,8 @@ class TaskExecutor:
             from ray_tpu.exceptions import ActorDiedError
             return {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
+        t0 = time.time()
+        status = "FINISHED"
         try:
             async with order["cond"]:
                 await order["cond"].wait_for(lambda: order["next"] >= seq)
@@ -163,13 +179,20 @@ class TaskExecutor:
             # ActorError), and hard-exit shortly after the reply flushes.
             # Never re-raise -- SystemExit escaping an asyncio task would tear
             # down the IO loop before the exit is scheduled.
+            status = "FAILED"
             await self._report_intended_exit()
             from ray_tpu.exceptions import ActorDiedError
             return {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
         except Exception as e:  # noqa: BLE001
+            status = "FAILED"
             await self._advance(order, seq)
             return {"ok": False, "error": _serialize_exception(e)}
+        finally:
+            self.core.record_task_event({
+                "task_id": msg["call_id"], "name": msg["method"],
+                "kind": "actor_call", "actor_id": self.actor_id,
+                "start": t0, "end": time.time(), "status": status})
 
     @staticmethod
     async def _advance(order: dict, seq: int):
@@ -180,6 +203,7 @@ class TaskExecutor:
 
     async def _report_intended_exit(self):
         self._exit_requested = True
+        await self.core.flush_task_events()
         if self.actor_id:
             try:
                 await self.core.gcs.request({"type": "report_actor_death",

@@ -1,0 +1,95 @@
+"""State observability API.
+
+Reference analogs: ``python/ray/experimental/state/api.py`` —
+list_actors:736, list_tasks:959, list_objects:1003 — backed by
+GcsTaskManager task events, plus ``ray status``/``ray summary`` views and
+the Chrome-trace timeline dump (``_private/state.py:435``
+chrome_tracing_dump).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _gcs_request(msg: dict):
+    from ray_tpu._private.worker import get_core
+    return get_core().gcs_request(msg)
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _gcs_request({"type": "get_nodes"})
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _gcs_request({"type": "list_actors"})
+
+
+def list_tasks(limit: int = 20000) -> List[Dict[str, Any]]:
+    """Finished/failed task executions from the GCS task-event log.
+    Default limit matches the GCS's 20000-event retention window."""
+    return _gcs_request({"type": "list_task_events", "limit": limit})
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Objects registered in the cluster object directory (plasma-sized;
+    inline objects live in their owners and are not globally tracked)."""
+    return _gcs_request({"type": "list_objects"})
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _gcs_request({"type": "list_placement_groups"})
+
+
+def cluster_summary() -> Dict[str, Any]:
+    """`ray summary`-style rollup: nodes, resources, actors, task stats."""
+    nodes = list_nodes()
+    actors = list_actors()
+    tasks = list_tasks()
+    res = _gcs_request({"type": "cluster_resources"})
+    by_status: Dict[str, int] = {}
+    by_name: Dict[str, Dict[str, Any]] = {}
+    for t in tasks:
+        by_status[t["status"]] = by_status.get(t["status"], 0) + 1
+        agg = by_name.setdefault(t.get("name") or "?", {
+            "count": 0, "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += max(0.0, t["end"] - t["start"])
+    return {
+        "nodes": {"alive": sum(1 for n in nodes if n["alive"]),
+                  "dead": sum(1 for n in nodes if not n["alive"])},
+        "resources": res,
+        "actors": {"total": len(actors),
+                   "alive": sum(1 for a in actors
+                                if a["state"] == "ALIVE")},
+        "tasks": {"by_status": by_status, "by_name": by_name},
+    }
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome trace (chrome://tracing / perfetto) of task executions
+    (reference: `ray timeline`, _private/state.py:435).
+
+    Rows: pid = node, tid = worker process (or actor).  Returns the event
+    list; writes JSON to `filename` when given.
+    """
+    events = list_tasks()
+    trace = []
+    for e in events:
+        tid = e.get("actor_id") or f"worker-{e.get('pid')}"
+        trace.append({
+            "ph": "X",
+            "name": e.get("name") or e.get("kind"),
+            "cat": e.get("kind", "task"),
+            "pid": f"node-{(e.get('node_id') or '')[:8]}",
+            "tid": tid,
+            "ts": e["start"] * 1e6,          # chrome wants microseconds
+            "dur": max(0.0, e["end"] - e["start"]) * 1e6,
+            "args": {"task_id": e.get("task_id"),
+                     "status": e.get("status")},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,149 @@
+"""State API, Chrome-trace timeline, metrics.
+
+Reference analogs: python/ray/tests/test_state_api.py, test_metrics_*, and
+`ray timeline` output format.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    ray_tpu.init(num_cpus=8, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=30):
+    deadline = time.monotonic() + timeout
+    while True:
+        v = pred()
+        if v:
+            return v
+        assert time.monotonic() < deadline, "condition never satisfied"
+        time.sleep(0.5)
+
+
+def test_list_tasks_records_executions(obs_cluster):
+    @ray_tpu.remote
+    def traced_add(a, b):
+        return a + b
+
+    assert ray_tpu.get([traced_add.remote(i, i) for i in range(4)]) == \
+        [0, 2, 4, 6]
+    tasks = _wait_for(lambda: [t for t in state.list_tasks()
+                               if t["name"] == "traced_add"])
+    assert len(tasks) >= 4
+    t = tasks[0]
+    assert t["status"] == "FINISHED"
+    assert t["end"] >= t["start"]
+    assert t["kind"] == "task"
+
+
+def test_list_tasks_records_actor_calls_and_failures(obs_cluster):
+    @ray_tpu.remote
+    class Obs:
+        def ok(self):
+            return 1
+
+        def boom(self):
+            raise ValueError("x")
+
+    a = Obs.remote()
+    assert ray_tpu.get(a.ok.remote()) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(a.boom.remote())
+    calls = _wait_for(lambda: [t for t in state.list_tasks()
+                               if t["kind"] == "actor_call" and
+                               t["name"] in ("ok", "boom")])
+    statuses = {t["name"]: t["status"] for t in calls}
+    assert statuses.get("ok") == "FINISHED"
+    assert statuses.get("boom") == "FAILED"
+
+
+def test_list_actors_nodes_summary(obs_cluster):
+    actors = state.list_actors()
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+    s = state.cluster_summary()
+    assert s["nodes"]["alive"] >= 1
+    assert "CPU" in s["resources"]["total"]
+    assert s["tasks"]["by_status"].get("FINISHED", 0) >= 1
+
+
+def test_timeline_chrome_trace(obs_cluster, tmp_path):
+    @ray_tpu.remote
+    def for_timeline():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([for_timeline.remote() for _ in range(3)])
+    _wait_for(lambda: [t for t in state.list_tasks()
+                       if t["name"] == "for_timeline"])
+    path = str(tmp_path / "trace.json")
+    events = ray_tpu.timeline(path)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded == events
+    mine = [e for e in loaded if e["name"] == "for_timeline"]
+    assert len(mine) >= 3
+    e = mine[0]
+    assert e["ph"] == "X" and e["dur"] > 0 and e["pid"].startswith("node-")
+
+
+def test_metrics_counter_gauge_histogram(obs_cluster):
+    c = metrics.Counter("rt_test_requests", tag_keys=("route",))
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(3.0, tags={"route": "/a"})
+    g = metrics.Gauge("rt_test_queue_len")
+    g.set(7.0)
+    h = metrics.Histogram("rt_test_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    def find(name):
+        return [m for m in metrics.collect() if m["name"] == name]
+
+    got = _wait_for(lambda: find("rt_test_requests"))
+    assert got[0]["value"] == 5.0 and got[0]["labels"] == {"route": "/a"}
+    assert find("rt_test_queue_len")[0]["value"] == 7.0
+    hist = find("rt_test_latency")[0]
+    assert hist["value"] == 3
+    assert hist["buckets"]["0.1"] == 1
+    assert hist["buckets"]["1.0"] == 1
+    assert hist["buckets"]["+Inf"] == 1
+
+    text = metrics.prometheus_text()
+    assert 'rt_test_requests{route="/a"} 5.0' in text
+    assert "rt_test_latency_bucket" in text
+
+
+def test_metrics_aggregate_across_workers(obs_cluster):
+    @ray_tpu.remote
+    class MetricActor:
+        def __init__(self):
+            from ray_tpu.util import metrics as m
+            self.c = m.Counter("rt_test_cross_proc")
+
+        def bump(self):
+            self.c.inc(1.0)
+            from ray_tpu.util import metrics as m
+            m.flush()
+            return True
+
+    a, b = MetricActor.remote(), MetricActor.remote()
+    ray_tpu.get([a.bump.remote(), b.bump.remote(), a.bump.remote()])
+
+    def total():
+        vals = [m for m in metrics.collect()
+                if m["name"] == "rt_test_cross_proc"]
+        return vals[0]["value"] if vals else 0
+
+    _wait_for(lambda: total() == 3.0)
