@@ -434,8 +434,14 @@ class Raylet:
             if w is not None and w.proc.poll() is None:
                 w.lease_id = None
                 w.busy = False
+                # Idle cap scales with node CPUs: spawning a worker costs
+                # ~1.5s of CPU (jax import) while an idle worker is nearly
+                # free, so tearing down above a tiny fixed cap thrashes
+                # (reference: worker_pool.h keeps num_cpus idle workers).
+                idle_cap = max(IDLE_WORKER_CAP_PER_SHAPE,
+                               int(2 * self.resources_total.get("CPU", 1)))
                 if msg.get("worker_reusable", True) and \
-                        len(self.idle_workers) < IDLE_WORKER_CAP_PER_SHAPE:
+                        len(self.idle_workers) < idle_cap:
                     self.idle_workers.append(w)
                 else:
                     w.proc.terminate()

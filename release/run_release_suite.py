@@ -1,0 +1,154 @@
+"""Release-suite runner (reference: release/ray_release runner, simplified).
+
+Reads release_tests.yaml, runs each entry's entrypoint, parses JSON-line
+metrics from stdout, evaluates success criteria, and writes
+release_results.json with per-test pass/fail.  Exit code 0 iff every
+selected test passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_suite(path: str):
+    """Minimal YAML-subset loader for the suite format above (the image
+    carries no yaml package; this reads the restricted shape we emit:
+    a list of flat mappings with string/number/inline-dict values)."""
+    try:
+        import yaml  # noqa: F401
+        with open(path) as f:
+            return yaml.safe_load(f)
+    except ImportError:
+        pass
+    tests = []
+    cur = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip()
+            if not line.strip() or line.strip().startswith("#"):
+                continue
+            if line.startswith("- name:"):
+                cur = {"name": line.split(":", 1)[1].strip(),
+                       "success_criteria": {}}
+                tests.append(cur)
+            elif line.startswith("  ") and cur is not None:
+                key, _, val = line.strip().partition(":")
+                val = val.split("#", 1)[0].strip()
+                if key == "suite":
+                    cur["suite"] = [s.strip() for s in
+                                    val.strip("[]").split(",")]
+                elif key == "timeout_s":
+                    cur["timeout_s"] = int(val)
+                elif key == "entrypoint":
+                    cur["entrypoint"] = val
+                elif key == "success_criteria":
+                    if val and val != "{}":
+                        raise ValueError("inline criteria must be {}")
+                elif val.startswith("{"):
+                    body = val.strip("{}")
+                    crit = {}
+                    for part in body.split(","):
+                        op, _, num = part.partition(":")
+                        crit[op.strip()] = float(num)
+                    cur["success_criteria"][key] = crit
+    return tests
+
+
+def _match_metric(metrics: dict, name: str):
+    """Exact metric-name match, else unique substring match (bench metric
+    names carry model/platform prefixes, e.g.
+    gpt2_small_train_samples_per_sec_per_chip)."""
+    if name in metrics:
+        return metrics[name]
+    hits = [m for k, m in metrics.items() if name in k]
+    return hits[0] if len(hits) == 1 else None
+
+
+def run_test(test: dict) -> dict:
+    t0 = time.time()
+    # start_new_session so a timeout can kill the whole process TREE —
+    # entrypoints spawn cluster daemons that would otherwise outlive the
+    # kill and poison later suite entries.
+    proc = subprocess.Popen(
+        test["entrypoint"], shell=True, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=test.get("timeout_s", 600))
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        import signal
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except Exception:
+            proc.kill()
+        out, _ = proc.communicate()
+        out = (out or "") + "\n<timeout>"
+        rc = -1
+    metrics = {}
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                if "metric" in rec:
+                    metrics[rec["metric"]] = rec
+            except json.JSONDecodeError:
+                continue
+    failures = []
+    if rc != 0:
+        failures.append(f"exit code {rc}")
+    for metric, crit in test.get("success_criteria", {}).items():
+        rec = _match_metric(metrics, metric)
+        if rec is None:
+            failures.append(f"metric {metric} missing")
+            continue
+        v = rec["value"]
+        if "min" in crit and v < crit["min"]:
+            failures.append(f"{metric}={v} < min {crit['min']}")
+        if "max" in crit and v > crit["max"]:
+            failures.append(f"{metric}={v} > max {crit['max']}")
+    return {"name": test["name"], "passed": not failures,
+            "failures": failures, "metrics": metrics,
+            "duration_s": round(time.time() - t0, 1),
+            "output_tail": out[-2000:]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default="smoke")
+    ap.add_argument("--yaml", default=os.path.join(
+        REPO, "release", "release_tests.yaml"))
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "release", "release_results.json"))
+    args = ap.parse_args()
+
+    tests = [t for t in load_suite(args.yaml)
+             if args.suite in t.get("suite", [])]
+    if not tests:
+        print(f"error: no tests match suite {args.suite!r}",
+              file=sys.stderr)
+        sys.exit(2)
+    results = []
+    for t in tests:
+        print(f"=== {t['name']} ({t['entrypoint']})", flush=True)
+        r = run_test(t)
+        print(f"    {'PASS' if r['passed'] else 'FAIL'} "
+              f"in {r['duration_s']}s {r['failures'] or ''}", flush=True)
+        results.append(r)
+    with open(args.out, "w") as f:
+        json.dump({"suite": args.suite, "when": time.time(),
+                   "results": results}, f, indent=2)
+    sys.exit(0 if all(r["passed"] for r in results) else 1)
+
+
+if __name__ == "__main__":
+    main()
