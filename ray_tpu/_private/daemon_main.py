@@ -64,6 +64,7 @@ async def amain(args) -> None:
         store_capacity=args.store_capacity,
         is_head=args.head,
         worker_env=worker_env,
+        labels=json.loads(args.labels) if args.labels else None,
     )
     raylet_port = await raylet.start(0)
 
@@ -97,7 +98,8 @@ async def amain(args) -> None:
                 return
             await asyncio.sleep(1.0)
 
-    asyncio.get_running_loop().create_task(watch_parent())
+    if not args.no_parent_watch:
+        asyncio.get_running_loop().create_task(watch_parent())
     await stop.wait()
     await raylet.close()
     if gcs is not None:
@@ -126,6 +128,12 @@ def main():
     parser.add_argument("--ready-file", required=True)
     parser.add_argument("--worker-env", default=None)
     parser.add_argument("--no-tpu-detect", action="store_true")
+    parser.add_argument("--no-parent-watch", action="store_true",
+                        help="Keep running after the launching process exits "
+                             "(used by the `ray_tpu start` CLI).")
+    parser.add_argument("--labels", default=None,
+                        help="JSON dict of node labels (e.g. autoscaler "
+                             "node-type tags)")
     args = parser.parse_args()
     logging.basicConfig(level=os.environ.get("RT_LOG_LEVEL", "WARNING"))
     try:

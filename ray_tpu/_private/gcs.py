@@ -27,8 +27,12 @@ from ray_tpu._private.protocol import RpcConnection, RpcServer
 
 logger = logging.getLogger(__name__)
 
+import os as _os
+
 HEARTBEAT_PERIOD_S = 0.5
-HEALTH_TIMEOUT_S = 5.0
+# Generous by default (reference health_check_timeout_ms=30s): on small/1-core
+# hosts a worker's jax import can starve daemons for seconds at a time.
+HEALTH_TIMEOUT_S = float(_os.environ.get("RT_HEALTH_TIMEOUT_S", "15.0"))
 
 # Actor lifecycle states (reference: gcs_actor_manager.h / rpc::ActorTableData)
 PENDING = "PENDING_CREATION"
@@ -49,6 +53,8 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     conn: Optional[RpcConnection] = None
     is_head: bool = False
+    # Unsatisfied lease shapes last reported by the raylet (autoscaler input).
+    pending_demand: List[Dict[str, float]] = field(default_factory=list)
 
     def public(self) -> dict:
         return {
@@ -225,6 +231,7 @@ class GcsServer:
         node.last_heartbeat = time.monotonic()
         if "resources_available" in msg:
             node.resources_available = msg["resources_available"]
+        node.pending_demand = msg.get("pending_leases", [])
         # Retry queued actors: availability may have just been freed (a
         # worker died / finished).  Without this, an actor that queued
         # during a transient full-node view waits for a *new node
@@ -238,6 +245,29 @@ class GcsServer:
 
     async def _h_get_nodes(self, conn, msg):
         return [n.public() for n in self.nodes.values()]
+
+    async def _h_get_load_metrics(self, conn, msg):
+        """Cluster load view for the autoscaler (reference:
+        autoscaler/_private/load_metrics.py fed by ray_syncer gossip)."""
+        pending_tasks: List[Dict[str, float]] = []
+        for node in self.nodes.values():
+            if node.alive:
+                pending_tasks.extend(node.pending_demand)
+        pending_actors = [
+            self.actors[aid].resources
+            for aid in self._pending_actor_queue if aid in self.actors]
+        pending_pg_bundles: List[Dict[str, float]] = []
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                for i, b in enumerate(pg.bundles):
+                    if i not in pg.allocations:
+                        pending_pg_bundles.append(b)
+        return {
+            "nodes": [n.public() for n in self.nodes.values()],
+            "pending_tasks": pending_tasks,
+            "pending_actors": pending_actors,
+            "pending_pg_bundles": pending_pg_bundles,
+        }
 
     async def _h_drain_node(self, conn, msg):
         node = self.nodes.get(NodeID.from_hex(msg["node_id"]))

@@ -157,6 +157,11 @@ class Raylet:
                     "type": "heartbeat",
                     "node_id": self.node_id.hex(),
                     "resources_available": self.resources_available,
+                    # Unsatisfied lease shapes = the node's resource demand
+                    # (reference: ray_syncer resource-load gossip feeding
+                    # autoscaler LoadMetrics).
+                    "pending_leases": [
+                        r.resources for r in self.pending_leases[:100]],
                 })
             except Exception:
                 pass

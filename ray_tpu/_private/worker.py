@@ -60,6 +60,10 @@ class Worker:
             "RT_DRIVER_SYS_PATH",
             os.pathsep.join(p or os.getcwd() for p in sys.path))
         if address is None:
+            # Reference parity: RAY_ADDRESS -> RT_ADDRESS lets `job submit`
+            # drivers and CLI tools connect without code changes.
+            address = os.environ.get("RT_ADDRESS") or None
+        if address is None:
             self._start_local_cluster(num_cpus, resources, object_store_memory,
                                       log_level, _worker_env)
             info = self._ready_info

@@ -1,0 +1,24 @@
+"""Elastic cluster scaling.
+
+Design analog: reference ``python/ray/autoscaler/_private/`` --
+StandardAutoscaler (autoscaler.py:167), ResourceDemandScheduler
+(resource_demand_scheduler.py:103), Monitor (monitor.py:126), NodeProvider
+(autoscaler/node_provider.py:13).
+
+TPU-first divergence: the scaling unit is a *node type* that may be an entire
+TPU slice (all hosts of a slice come and go together -- a slice is atomic,
+unlike the reference's per-VM granularity).
+"""
+
+from ray_tpu.autoscaler.node_provider import (NodeProvider, NodeTypeConfig,
+                                              LocalNodeProvider)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    ResourceDemandScheduler, fits, subtract)
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.monitor import Monitor
+
+__all__ = [
+    "NodeProvider", "NodeTypeConfig", "LocalNodeProvider",
+    "ResourceDemandScheduler", "StandardAutoscaler", "AutoscalerConfig",
+    "Monitor", "fits", "subtract",
+]

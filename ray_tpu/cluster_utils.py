@@ -55,7 +55,8 @@ class Cluster:
     def _start_node(self, head: bool = False, num_cpus: int = 4,
                     resources: Optional[Dict[str, float]] = None,
                     object_store_memory: int = 256 * 1024 * 1024,
-                    env: Optional[Dict[str, str]] = None) -> ClusterNode:
+                    env: Optional[Dict[str, str]] = None,
+                    labels: Optional[Dict[str, str]] = None) -> ClusterNode:
         ready_file = os.path.join(
             tempfile.gettempdir(),
             f"rt_node_{os.getpid()}_{uuid.uuid4().hex[:8]}.json")
@@ -66,6 +67,8 @@ class Cluster:
                "--resources", json.dumps(res),
                "--store-capacity", str(object_store_memory),
                "--no-tpu-detect"]
+        if labels:
+            cmd += ["--labels", json.dumps(labels)]
         if head:
             cmd.append("--head")
         else:
@@ -88,11 +91,12 @@ class Cluster:
     def add_node(self, num_cpus: int = 4,
                  resources: Optional[Dict[str, float]] = None,
                  object_store_memory: int = 256 * 1024 * 1024,
-                 env: Optional[Dict[str, str]] = None) -> ClusterNode:
+                 env: Optional[Dict[str, str]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> ClusterNode:
         node = self._start_node(head=False, num_cpus=num_cpus,
                                 resources=resources,
                                 object_store_memory=object_store_memory,
-                                env=env)
+                                env=env, labels=labels)
         self.worker_nodes.append(node)
         return node
 

@@ -1,0 +1,12 @@
+"""Job submission: run driver scripts as supervised subprocesses on the
+cluster.
+
+Design analog: reference ``dashboard/modules/job/`` -- JobManager
+(job_manager.py:490), JobSupervisor actor (job_manager.py:136),
+JobSubmissionClient (sdk.py:40).
+"""
+
+from ray_tpu.job.job_manager import (JobManager, JobStatus, JobInfo)
+from ray_tpu.job.sdk import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobInfo", "JobSubmissionClient"]

@@ -1,0 +1,286 @@
+"""`python -m ray_tpu` CLI: cluster lifecycle, observability, jobs.
+
+Design analog: reference ``python/ray/scripts/scripts.py`` -- `ray start:529`,
+`ray stop`, `ray status`, `ray list ...` (experimental/state CLI), `ray
+timeline`, `ray memory`, `ray job submit/status/logs/stop/list`, `ray
+microbenchmark`.
+
+Cluster bookkeeping lives in a session file (default
+``/tmp/ray_tpu/cluster.json``) recording daemon PIDs + the GCS address, the
+CLI's equivalent of the reference's ``/tmp/ray/ray_current_cluster``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+SESSION_DIR = os.environ.get("RT_SESSION_DIR",
+                             os.path.join(tempfile.gettempdir(), "ray_tpu"))
+SESSION_FILE = os.path.join(SESSION_DIR, "cluster.json")
+
+
+def _load_session() -> dict:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {"nodes": []}
+
+
+def _save_session(sess: dict):
+    os.makedirs(SESSION_DIR, exist_ok=True)
+    tmp = SESSION_FILE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(sess, f, indent=2)
+    os.replace(tmp, SESSION_FILE)
+
+
+def _connect(args):
+    import ray_tpu
+    address = getattr(args, "address", None) or \
+        os.environ.get("RT_ADDRESS") or _load_session().get("gcs_address")
+    if not address:
+        sys.exit("error: no running cluster found (no --address, RT_ADDRESS, "
+                 f"or {SESSION_FILE})")
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+# --------------------------------------------------------------- start/stop
+
+
+def cmd_start(args):
+    sess = _load_session()
+    ready_file = os.path.join(
+        SESSION_DIR, f"node_{uuid.uuid4().hex[:8]}.json")
+    os.makedirs(SESSION_DIR, exist_ok=True)
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    cmd = [sys.executable, "-m", "ray_tpu._private.daemon_main",
+           "--ready-file", ready_file,
+           "--store-capacity", str(args.object_store_memory),
+           "--no-parent-watch"]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    if args.head:
+        cmd += ["--head", "--gcs-port", str(args.port)]
+    else:
+        address = args.address or sess.get("gcs_address")
+        if not address:
+            sys.exit("error: worker start needs --address (or a head in the "
+                     "session file)")
+        cmd += ["--gcs-address", address]
+    log_path = os.path.join(SESSION_DIR,
+                            f"daemon_{uuid.uuid4().hex[:8]}.log")
+    with open(log_path, "ab") as logf:
+        proc = subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(ready_file):
+        if proc.poll() is not None:
+            sys.exit(f"node daemon exited rc={proc.returncode}; "
+                     f"log: {log_path}")
+        if time.monotonic() > deadline:
+            sys.exit(f"node daemon not ready after 60s; log: {log_path}")
+        time.sleep(0.2)
+    with open(ready_file) as f:
+        info = json.load(f)
+    sess.setdefault("nodes", []).append(
+        {"pid": proc.pid, "node_id": info["node_id"], "head": args.head,
+         "log": log_path})
+    if args.head:
+        sess["gcs_address"] = info["gcs_address"]
+    _save_session(sess)
+    print(f"node started: node_id={info['node_id']} pid={proc.pid}")
+    if args.head:
+        print(f"GCS address: {info['gcs_address']}")
+        print(f"connect with: ray_tpu.init(address=\"{info['gcs_address']}\")"
+              f"  # or RT_ADDRESS={info['gcs_address']}")
+    if args.block:
+        try:
+            proc.wait()
+        except KeyboardInterrupt:
+            proc.terminate()
+
+
+def cmd_stop(args):
+    sess = _load_session()
+    stopped = 0
+    for node in sess.get("nodes", []):
+        try:
+            os.kill(node["pid"], signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    # Head last is unnecessary: SIGTERM is graceful in daemon_main.
+    for node in sess.get("nodes", []):
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                os.kill(node["pid"], 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            try:
+                os.kill(node["pid"], signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    _save_session({"nodes": []})
+    print(f"stopped {stopped} node daemon(s)")
+
+
+# ------------------------------------------------------------ observability
+
+
+def cmd_status(args):
+    rt = _connect(args)
+    from ray_tpu.util import state
+    s = state.cluster_summary()
+    print(json.dumps(s, indent=2, default=str))
+
+
+def cmd_list(args):
+    rt = _connect(args)
+    from ray_tpu.util import state
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[args.what]
+    rows = fn()
+    print(json.dumps(rows[:args.limit], indent=2, default=str))
+    if len(rows) > args.limit:
+        print(f"... {len(rows) - args.limit} more (use --limit)",
+              file=sys.stderr)
+
+
+def cmd_timeline(args):
+    rt = _connect(args)
+    events = rt.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+def cmd_memory(args):
+    rt = _connect(args)
+    from ray_tpu.util import state
+    print(json.dumps(state.list_objects(), indent=2, default=str))
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu._private.microbenchmark import main as bench_main
+    bench_main()
+
+
+# --------------------------------------------------------------------- jobs
+
+
+def cmd_job(args):
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient(
+        getattr(args, "address", None) or
+        os.environ.get("RT_ADDRESS") or _load_session().get("gcs_address"))
+    if args.job_cmd == "submit":
+        import shlex
+        ep = args.entrypoint
+        if ep and ep[0] == "--":
+            ep = ep[1:]
+        sid = client.submit_job(entrypoint=shlex.join(ep))
+        print(f"submitted: {sid}")
+        if args.wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(client.get_job_logs(sid), end="")
+            print(f"status: {status}")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.id))
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}  {info.status:10s}  "
+                  f"{info.entrypoint}")
+
+
+# --------------------------------------------------------------------- main
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a node daemon on this host")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address to join (worker nodes)")
+    sp.add_argument("--port", type=int, default=6380,
+                    help="GCS port (head only)")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--resources", help="JSON resource dict")
+    sp.add_argument("--object-store-memory", type=int,
+                    default=512 * 1024 * 1024)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all node daemons in the session")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in [("status", cmd_status)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("what", choices=["nodes", "actors", "tasks", "objects",
+                                     "placement-groups"])
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline", help="dump Chrome trace of task events")
+    sp.add_argument("--address")
+    sp.add_argument("--output", default="timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="dump the cluster object directory")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("microbenchmark", help="run the perf microbenchmark")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("job")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="shell command to run as the job driver")
+    for name in ["status", "logs", "stop"]:
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+        j.add_argument("--address")
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

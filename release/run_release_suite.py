@@ -68,8 +68,14 @@ def _match_metric(metrics: dict, name: str):
     gpt2_small_train_samples_per_sec_per_chip)."""
     if name in metrics:
         return metrics[name]
-    hits = [m for k, m in metrics.items() if name in k]
-    return hits[0] if len(hits) == 1 else None
+    hits = [(k, m) for k, m in metrics.items() if name in k]
+    if len(hits) == 1:
+        return hits[0][1]
+    if len(hits) > 1:
+        raise ValueError(
+            f"criteria name {name!r} is ambiguous: matches "
+            f"{sorted(k for k, _ in hits)}")
+    return None
 
 
 def run_test(test: dict) -> dict:
@@ -107,7 +113,11 @@ def run_test(test: dict) -> dict:
     if rc != 0:
         failures.append(f"exit code {rc}")
     for metric, crit in test.get("success_criteria", {}).items():
-        rec = _match_metric(metrics, metric)
+        try:
+            rec = _match_metric(metrics, metric)
+        except ValueError as e:
+            failures.append(str(e))
+            continue
         if rec is None:
             failures.append(f"metric {metric} missing")
             continue

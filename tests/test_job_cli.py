@@ -1,0 +1,107 @@
+"""Job submission + CLI lifecycle.
+
+Reference analogs: python/ray/tests/test_job_manager.py (JobManager
+submit/status/logs/stop) and the `ray start/status/stop` CLI smoke path
+(scripts.py:529).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def job_cluster():
+    ray_tpu.init(num_cpus=4, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_job_submit_succeeds_and_streams_logs(job_cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info.end_time >= info.start_time > 0
+
+
+def test_job_entrypoint_can_join_cluster(job_cluster):
+    """The submitted driver sees RT_ADDRESS and runs tasks on this cluster."""
+    script = (
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"           # picks up RT_ADDRESS
+        "@ray_tpu.remote\n"
+        "def f(): return 21 * 2\n"
+        "print('answer=', ray_tpu.get(f.remote()))\n")
+    path = os.path.join(tempfile.gettempdir(),
+                        f"rt_job_script_{uuid.uuid4().hex[:6]}.py")
+    with open(path, "w") as f:
+        f.write(script)
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} {path}")
+    assert client.wait_until_finished(sid, timeout=180) == \
+        JobStatus.SUCCEEDED
+    assert "answer= 42" in client.get_job_logs(sid)
+
+
+def test_job_failure_and_stop(job_cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finished(sid, timeout=120) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(sid).message
+
+    sid2 = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    assert client.get_job_status(sid2) in (JobStatus.RUNNING,
+                                           JobStatus.PENDING)
+    assert client.stop_job(sid2)
+    assert client.wait_until_finished(sid2, timeout=60) == JobStatus.STOPPED
+
+    ids = {j.submission_id for j in client.list_jobs()}
+    assert {sid, sid2} <= ids
+
+
+def test_cli_start_status_stop():
+    """`ray_tpu start --head` -> `status` -> job submit --wait -> `stop`,
+    all through the console entrypoint in a private session dir."""
+    sess_dir = os.path.join(tempfile.gettempdir(),
+                            f"rt_cli_{uuid.uuid4().hex[:6]}")
+    env = dict(os.environ, RT_SESSION_DIR=sess_dir, JAX_PLATFORMS="cpu")
+
+    def cli(*argv, timeout=180):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu"] + list(argv),
+            env=env, capture_output=True, text=True, timeout=timeout)
+
+    r = cli("start", "--head", "--num-cpus", "2", "--port", "0")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "GCS address:" in r.stdout
+    try:
+        r = cli("status")
+        assert r.returncode == 0, r.stdout + r.stderr
+        summary = json.loads(r.stdout)
+        assert summary["nodes"]["alive"] >= 1
+
+        r = cli("job", "submit", "--wait", "--",
+                sys.executable, "-c", "print('cli job ran')")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "cli job ran" in r.stdout
+
+        r = cli("list", "nodes")
+        assert r.returncode == 0 and json.loads(r.stdout)
+    finally:
+        r = cli("stop")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stopped" in r.stdout

@@ -84,8 +84,8 @@ def test_timeline_chrome_trace(obs_cluster, tmp_path):
         return 1
 
     ray_tpu.get([for_timeline.remote() for _ in range(3)])
-    _wait_for(lambda: [t for t in state.list_tasks()
-                       if t["name"] == "for_timeline"])
+    _wait_for(lambda: len([t for t in state.list_tasks()
+                           if t["name"] == "for_timeline"]) >= 3)
     path = str(tmp_path / "trace.json")
     events = ray_tpu.timeline(path)
     with open(path) as f:
