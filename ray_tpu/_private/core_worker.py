@@ -97,6 +97,8 @@ class CoreWorker:
         # TaskEventBuffer, task_event_buffer.h).
         self._task_events: list = []
         self._event_flusher_started = False
+        # Pubsub: channel -> callbacks (reference pubsub/subscriber.h).
+        self._subscriptions: Dict[str, list] = {}
 
         self.plasma: Optional[PlasmaClient] = None
         if store_name:
@@ -165,8 +167,44 @@ class CoreWorker:
 
     async def _handle_push(self, msg: dict):
         if msg.get("type") == "pub":
+            # Reference pubsub Subscriber (pubsub/subscriber.h): dispatch to
+            # local channel callbacks; user callbacks must not block the IO
+            # loop, so they run on the executor thread pool.
+            def _log_cb_error(fut):
+                if fut.exception() is not None:
+                    logger.error("pubsub callback failed",
+                                 exc_info=fut.exception())
+
+            for cb in list(self._subscriptions.get(msg.get("channel"), [])):
+                fut = self.loop.run_in_executor(None, cb, msg.get("data"))
+                fut.add_done_callback(_log_cb_error)
             return None
         raise ValueError(f"unexpected push {msg.get('type')}")
+
+    def subscribe(self, channel: str, callback) -> None:
+        """Invoke callback(data) for every event published on channel
+        ('nodes', 'actors', ...). Reference: GcsSubscriber channels
+        (pubsub/publisher.h:298)."""
+        first = channel not in self._subscriptions
+        self._subscriptions.setdefault(channel, []).append(callback)
+        if first:
+            self.gcs_request({"type": "subscribe", "channel": channel})
+
+    def unsubscribe(self, channel: str, callback=None) -> None:
+        if callback is None:
+            self._subscriptions.pop(channel, None)
+        else:
+            cbs = self._subscriptions.get(channel, [])
+            if callback in cbs:
+                cbs.remove(callback)
+            if not cbs:
+                self._subscriptions.pop(channel, None)
+        if channel not in self._subscriptions:
+            # Tell the GCS to stop pushing this channel at us.
+            try:
+                self.gcs_request({"type": "unsubscribe", "channel": channel})
+            except Exception:
+                pass
 
     def _make_handler(self, conn: RpcConnection):
         async def handle(msg: dict):
@@ -352,10 +390,13 @@ class CoreWorker:
         self.object_events.pop(h, None)
         if self.plasma is not None and (entry is None or entry[0] == "plasma"):
             try:
-                if self.plasma.delete(oid):
-                    asyncio.ensure_future(self.gcs.notify({
-                        "type": "object_location_remove",
-                        "object_id": h, "node_id": self.node_id_hex}), loop=self.loop)
+                self.plasma.delete(oid)
+                # Fan out cluster-wide deletion (remote copies AND spill
+                # files) through the GCS object directory — a spilled
+                # object has no local plasma copy, so this must fire even
+                # when the local delete was a no-op.
+                asyncio.ensure_future(self.gcs.notify({
+                    "type": "object_freed", "object_id": h}), loop=self.loop)
             except Exception:
                 pass
 
@@ -378,13 +419,29 @@ class CoreWorker:
         self._run(self._put_serialized(oid, ser))
         return ref
 
+    async def _plasma_put(self, oid: ObjectID, ser) -> None:
+        """put_bytes with one spill-and-retry on a full store (reference:
+        plasma CreateRequestQueue retrying after LocalObjectManager spills)."""
+        from ray_tpu._private.plasma import ObjectStoreFullError
+        try:
+            self.plasma.put_bytes(oid, ser.segments, allow_evict=False)
+        except ObjectStoreFullError:
+            if self.raylet is None:
+                raise
+            await self.raylet.request(
+                {"type": "spill_request", "bytes": ser.total_size},
+                timeout=60)
+            # Still-full now falls back to LRU eviction rather than failing:
+            # everything spillable has been spilled.
+            self.plasma.put_bytes(oid, ser.segments)
+
     async def _put_serialized(self, oid: ObjectID, ser) -> None:
         h = oid.hex()
         self.owned.add(h)
         if ser.total_size <= INLINE_MAX or self.plasma is None:
             self._store_local(h, "val", ser.to_bytes())
         else:
-            self.plasma.put_bytes(oid, ser.segments)
+            await self._plasma_put(oid, ser)
             self._store_local(h, "plasma", None)
             await self.gcs.request({"type": "object_location_add",
                                     "object_id": h,
@@ -1008,7 +1065,7 @@ class CoreWorker:
         h = oid.hex()
         if ser.total_size <= INLINE_MAX or self.plasma is None:
             return (h, "inline", ser.to_bytes())
-        self.plasma.put_bytes(oid, ser.segments)
+        self._run_on_loop_sync(self._plasma_put(oid, ser))
         self._run_on_loop_sync(self.gcs.request({
             "type": "object_location_add", "object_id": h,
             "node_id": self.node_id_hex, "owner": ""}))

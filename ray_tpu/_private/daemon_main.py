@@ -31,7 +31,7 @@ async def amain(args) -> None:
     node_id = NodeID.from_random()
     gcs = None
     if args.head:
-        gcs = GcsServer()
+        gcs = GcsServer(persist_path=args.gcs_persist_path)
         gcs_port = await gcs.start(args.gcs_port)
         gcs_address = f"127.0.0.1:{gcs_port}"
     else:
@@ -128,6 +128,10 @@ def main():
     parser.add_argument("--ready-file", required=True)
     parser.add_argument("--worker-env", default=None)
     parser.add_argument("--no-tpu-detect", action="store_true")
+    parser.add_argument("--gcs-persist-path", default=None,
+                        help="JSON snapshot file for GCS fault tolerance "
+                             "(head only; reference: Redis-backed "
+                             "gcs_table_storage)")
     parser.add_argument("--no-parent-watch", action="store_true",
                         help="Keep running after the launching process exits "
                              "(used by the `ray_tpu start` CLI).")

@@ -104,6 +104,16 @@ class ActorInfo:
 
 
 @dataclass
+class ObjectDirEntry:
+    """Object directory record: in-memory copies + spilled-to-disk copies
+    (reference: OwnershipBasedObjectDirectory + LocalObjectManager spilled
+    URLs, local_object_manager.h:41)."""
+    owner: str
+    nodes: Set[str] = field(default_factory=set)
+    spilled: Dict[str, str] = field(default_factory=dict)  # node hex -> path
+
+
+@dataclass
 class PlacementGroupInfo:
     pg_id: PlacementGroupID
     bundles: List[Dict[str, float]]
@@ -112,6 +122,9 @@ class PlacementGroupInfo:
     # bundle index -> node_id
     allocations: Dict[int, NodeID] = field(default_factory=dict)
     waiters: List[asyncio.Future] = field(default_factory=list)
+    # Re-entrancy guard: heartbeat- and register-triggered retries must not
+    # double-reserve bundles while a reservation round-trip is in flight.
+    scheduling_in_progress: bool = False
 
     def public(self) -> dict:
         return {
@@ -134,7 +147,7 @@ class GcsServer:
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.jobs: Dict[str, dict] = {}
         # object_id hex -> (owner address, set of node hexes with a copy)
-        self.object_dir: Dict[str, Tuple[str, Set[str]]] = {}
+        self.object_dir: Dict[str, ObjectDirEntry] = {}
         self.subscribers: Dict[str, List[RpcConnection]] = {}
         from collections import deque
         self.task_events: "deque" = deque(maxlen=20000)
@@ -142,19 +155,128 @@ class GcsServer:
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
         self._health_task: Optional[asyncio.Task] = None
+        self._snapshot_task: Optional[asyncio.Task] = None
+        self._dirty = False
         self._pending_actor_queue: List[ActorID] = []
 
     async def start(self, port: int = 0) -> int:
+        if self._persist_path:
+            self._load_snapshot()
         port = await self.server.start(port)
         self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
+        if self._persist_path:
+            self._snapshot_task = asyncio.get_running_loop().create_task(
+                self._snapshot_loop())
         return port
 
     async def close(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._snapshot_task:
+            self._snapshot_task.cancel()
+        if self._persist_path:
+            try:
+                self._write_snapshot()
+            except Exception:
+                logger.exception("final GCS snapshot failed")
         await self.server.close()
 
+    # ------------------------------------------------- snapshot persistence
+
+    def _snapshot_state(self) -> dict:
+        """Durable cluster metadata (reference: gcs_table_storage.h:252 —
+        the tables that survive a head restart via Redis).  Runtime state
+        (node connections, leases, object locations) re-forms when raylets
+        reconnect and is deliberately not persisted."""
+        import base64
+        b64 = lambda b: base64.b64encode(b).decode()  # noqa: E731
+        return {
+            "kv": {ns: {b64(k): b64(v) for k, v in table.items()}
+                   for ns, table in self.kv.items()},
+            "jobs": self.jobs,
+            "named_actors": [
+                [ns, name, aid.hex()]
+                for (ns, name), aid in self.named_actors.items()],
+            "actors": [
+                {"actor_id": a.actor_id.hex(), "name": a.name,
+                 "namespace": a.namespace,
+                 "creation_spec": b64(a.creation_spec),
+                 "resources": a.resources, "max_restarts": a.max_restarts,
+                 "num_restarts": a.num_restarts, "detached": a.detached,
+                 "scheduling": a.scheduling}
+                for a in self.actors.values() if a.detached],
+            "placement_groups": [
+                {"pg_id": pg.pg_id.hex(), "bundles": pg.bundles,
+                 "strategy": pg.strategy}
+                for pg in self.placement_groups.values()
+                if pg.state != "REMOVED"],
+        }
+
+    def _write_snapshot(self):
+        tmp = self._persist_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._snapshot_state(), f)
+        _os.replace(tmp, self._persist_path)
+        self._dirty = False
+
+    def _load_snapshot(self):
+        import base64
+        try:
+            with open(self._persist_path) as f:
+                snap = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return
+        ub = base64.b64decode
+        self.kv = {ns: {ub(k): ub(v) for k, v in table.items()}
+                   for ns, table in snap.get("kv", {}).items()}
+        self.jobs = snap.get("jobs", {})
+        # Detached actors restart from their persisted creation spec once
+        # nodes re-register (same path as restart-on-node-death).
+        for rec in snap.get("actors", []):
+            actor = ActorInfo(
+                actor_id=ActorID.from_hex(rec["actor_id"]),
+                name=rec["name"], namespace=rec["namespace"],
+                state=RESTARTING,
+                creation_spec=ub(rec["creation_spec"]),
+                resources=rec["resources"],
+                max_restarts=rec["max_restarts"],
+                num_restarts=rec["num_restarts"],
+                detached=True, scheduling=rec.get("scheduling", {}))
+            self.actors[actor.actor_id] = actor
+            self._pending_actor_queue.append(actor.actor_id)
+        for ns, name, aid in snap.get("named_actors", []):
+            self.named_actors[(ns, name)] = ActorID.from_hex(aid)
+        for rec in snap.get("placement_groups", []):
+            pg = PlacementGroupInfo(
+                pg_id=PlacementGroupID.from_hex(rec["pg_id"]),
+                bundles=rec["bundles"], strategy=rec["strategy"],
+                state="PENDING")
+            self.placement_groups[pg.pg_id] = pg
+        logger.info("GCS restored snapshot from %s (%d kv namespaces, "
+                    "%d detached actors, %d pgs)", self._persist_path,
+                    len(self.kv), len(snap.get("actors", [])),
+                    len(snap.get("placement_groups", [])))
+
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._dirty:
+                continue
+            try:
+                self._write_snapshot()
+            except Exception:
+                logger.exception("GCS snapshot write failed")
+
+    def _mark_dirty(self):
+        self._dirty = True
+
     # ------------------------------------------------------------------ rpc
+
+    # Message types that change durable state (snapshot triggers).
+    _DURABLE_MUTATIONS = frozenset({
+        "kv_put", "kv_del", "register_actor", "create_actor", "kill_actor",
+        "report_actor_death", "register_job", "finish_job",
+        "create_placement_group", "remove_placement_group"})
 
     def _make_handler(self, conn: RpcConnection):
         async def handle(msg: dict):
@@ -162,7 +284,10 @@ class GcsServer:
             fn = getattr(self, f"_h_{mtype}", None)
             if fn is None:
                 raise ValueError(f"gcs: unknown message type {mtype}")
-            return await fn(conn, msg)
+            result = await fn(conn, msg)
+            if mtype in self._DURABLE_MUTATIONS:
+                self._dirty = True
+            return result
 
         conn.on_close = self._on_conn_close
         return handle
@@ -295,9 +420,11 @@ class GcsServer:
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state in (ALIVE, PENDING, RESTARTING):
                 await self._on_actor_failure(actor, f"node {node.node_id.hex()} died")
-        # Drop object locations on that node.
-        for oid, (owner, locs) in list(self.object_dir.items()):
-            locs.discard(node.node_id.hex())
+        # Drop object locations on that node (its spill files die with it).
+        nh = node.node_id.hex()
+        for oid, entry in list(self.object_dir.items()):
+            entry.nodes.discard(nh)
+            entry.spilled.pop(nh, None)
 
     # ------------------------------------------------------------------ jobs
 
@@ -445,6 +572,11 @@ class GcsServer:
             actor = self.actors.get(actor_id)
             if actor is not None and actor.state in (PENDING, RESTARTING):
                 await self._schedule_actor(actor)
+        # PGs restored from a snapshot (or whose placement failed earlier)
+        # retry whenever capacity appears.
+        for pg in list(self.placement_groups.values()):
+            if pg.state == "PENDING":
+                await self._schedule_pg(pg)
 
     async def _on_actor_failure(self, actor: ActorInfo, reason: str):
         node = self.nodes.get(actor.node_id) if actor.node_id else None
@@ -561,6 +693,15 @@ class GcsServer:
         bundle_scheduling_policy.h).  PACK fills one node first; SPREAD
         round-robins; STRICT_PACK requires a single node; STRICT_SPREAD
         requires distinct nodes."""
+        if pg.scheduling_in_progress or pg.state != "PENDING":
+            return
+        pg.scheduling_in_progress = True
+        try:
+            await self._schedule_pg_inner(pg)
+        finally:
+            pg.scheduling_in_progress = False
+
+    async def _schedule_pg_inner(self, pg: PlacementGroupInfo):
         avail = {n.node_id: dict(n.resources_available)
                  for n in self.nodes.values() if n.alive}
         order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
@@ -689,29 +830,84 @@ class GcsServer:
         owner = msg.get("owner", "")
         entry = self.object_dir.get(oid)
         if entry is None:
-            self.object_dir[oid] = (owner, {msg["node_id"]})
+            self.object_dir[oid] = ObjectDirEntry(owner, {msg["node_id"]})
         else:
-            entry[1].add(msg["node_id"])
+            entry.nodes.add(msg["node_id"])
+            entry.spilled.pop(msg["node_id"], None)  # restored
         return {"ok": True}
 
     async def _h_object_locations_get(self, conn, msg):
         entry = self.object_dir.get(msg["object_id"])
         if entry is None:
             return None
-        return {"owner": entry[0], "nodes": list(entry[1])}
+        return {"owner": entry.owner, "nodes": list(entry.nodes),
+                "spilled": dict(entry.spilled)}
 
     async def _h_object_location_remove(self, conn, msg):
         entry = self.object_dir.get(msg["object_id"])
         if entry is not None:
-            entry[1].discard(msg["node_id"])
-            if not entry[1]:
+            entry.nodes.discard(msg["node_id"])
+            if not entry.nodes and not entry.spilled:
                 del self.object_dir[msg["object_id"]]
+        return {"ok": True}
+
+    async def _h_object_spilled(self, conn, msg):
+        """A node moved its in-memory copy to disk (reference:
+        LocalObjectManager::SpillObjects reporting spilled URLs)."""
+        entry = self.object_dir.get(msg["object_id"])
+        if entry is None:
+            entry = self.object_dir[msg["object_id"]] = ObjectDirEntry(
+                msg.get("owner", ""))
+        entry.spilled[msg["node_id"]] = msg["path"]
+        entry.nodes.discard(msg["node_id"])
+        return {"ok": True}
+
+    async def _h_objects_on_node(self, conn, msg):
+        """Plasma-resident object ids on a node (spill candidate listing)."""
+        node = msg["node_id"]
+        return [oid for oid, e in self.object_dir.items()
+                if node in e.nodes]
+
+    async def _h_object_freed(self, conn, msg):
+        """Owner dropped its last reference: delete every copy cluster-wide,
+        including spill files (reference: ReferenceCounter eager deletion
+        fanning out through the object directory)."""
+        entry = self.object_dir.pop(msg["object_id"], None)
+        if entry is None:
+            return {"ok": True}
+        by_hex = {n.node_id.hex(): n for n in self.nodes.values()}
+        for nh in entry.nodes:
+            node = by_hex.get(nh)
+            if node is not None and node.alive and node.conn is not None:
+                try:
+                    await node.conn.notify({
+                        "type": "delete_object",
+                        "object_id": msg["object_id"]})
+                except Exception:
+                    pass
+        for nh, path in entry.spilled.items():
+            node = by_hex.get(nh)
+            if node is not None and node.alive and node.conn is not None:
+                try:
+                    await node.conn.notify({
+                        "type": "delete_spilled",
+                        "object_id": msg["object_id"], "path": path})
+                except Exception:
+                    pass
         return {"ok": True}
 
     # ------------------------------------------------------------- pubsub
 
     async def _h_subscribe(self, conn, msg):
-        self.subscribers.setdefault(msg["channel"], []).append(conn)
+        subs = self.subscribers.setdefault(msg["channel"], [])
+        if conn not in subs:
+            subs.append(conn)
+        return {"ok": True}
+
+    async def _h_unsubscribe(self, conn, msg):
+        subs = self.subscribers.get(msg["channel"], [])
+        if conn in subs:
+            subs.remove(conn)
         return {"ok": True}
 
     # ------------------------------------------------- observability
@@ -728,9 +924,10 @@ class GcsServer:
         return evs[-limit:]
 
     async def _h_list_objects(self, conn, msg):
-        return [{"object_id": oid, "owner": owner,
-                 "locations": sorted(locs)}
-                for oid, (owner, locs) in self.object_dir.items()]
+        return [{"object_id": oid, "owner": e.owner,
+                 "locations": sorted(e.nodes),
+                 "spilled": dict(e.spilled)}
+                for oid, e in self.object_dir.items()]
 
     async def _h_list_placement_groups(self, conn, msg):
         return [{"pg_id": pg.pg_id.hex(), "bundles": pg.bundles,

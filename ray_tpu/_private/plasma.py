@@ -86,8 +86,22 @@ class PlasmaClient:
 
     # -- object ops --
 
-    def create(self, object_id: ObjectID, size: int) -> memoryview:
-        """Allocate an unsealed object; returns a writable view of its payload."""
+    def create(self, object_id: ObjectID, size: int,
+               allow_evict: bool = True) -> memoryview:
+        """Allocate an unsealed object; returns a writable view of its payload.
+
+        allow_evict=False refuses allocations that would need LRU eviction
+        (best-effort: checks byte headroom, not fragmentation) and raises
+        ObjectStoreFullError instead -- used for primary copies, which must
+        be *spilled* to disk rather than silently dropped (reference: plasma
+        pins primary copies; eviction only takes secondary copies)."""
+        if not allow_evict:
+            st = self.stats()
+            if st["bytes_used"] + size > st["capacity"]:
+                raise ObjectStoreFullError(
+                    f"{size} bytes would exceed store capacity "
+                    f"({st['bytes_used']}/{st['capacity']} used) and "
+                    f"eviction is disallowed for primary copies")
         off = ctypes.c_uint64()
         rc = self._lib.store_create_object(self._h, object_id.binary(), size,
                                            ctypes.byref(off))
@@ -105,10 +119,11 @@ class PlasmaClient:
         if rc != 0:
             raise RuntimeError(f"seal failed rc={rc}")
 
-    def put_bytes(self, object_id: ObjectID, payloads: List[bytes]) -> int:
+    def put_bytes(self, object_id: ObjectID, payloads: List[bytes],
+                  allow_evict: bool = True) -> int:
         """Create+write+seal a multi-buffer object. Layout: see serialization.py."""
         total = sum(len(p) for p in payloads)
-        buf = self.create(object_id, total)
+        buf = self.create(object_id, total, allow_evict=allow_evict)
         pos = 0
         for p in payloads:
             buf[pos:pos + len(p)] = p

@@ -26,6 +26,7 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -37,6 +38,11 @@ from ray_tpu._private.protocol import RpcConnection, RpcServer, connect
 logger = logging.getLogger(__name__)
 
 TRANSFER_CHUNK = 4 * 1024 * 1024  # 4MB frames for node-to-node object pushes
+
+# Spill thresholds as fractions of store capacity (reference:
+# object_spilling_threshold / RAY_object_store_memory high-water behavior).
+SPILL_HIGH_WATER = float(os.environ.get("RT_SPILL_HIGH_WATER", "0.8"))
+SPILL_LOW_WATER = float(os.environ.get("RT_SPILL_LOW_WATER", "0.6"))
 IDLE_WORKER_CAP_PER_SHAPE = 8
 
 
@@ -50,6 +56,7 @@ class WorkerHandle:
     actor_id: Optional[str] = None
     lease_id: Optional[str] = None
     busy: bool = False
+    busy_since: float = 0.0              # monotonic; OOM-kill ordering
     actor_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
     actor_created: bool = False  # create_actor completed on this worker
 
@@ -93,6 +100,13 @@ class Raylet:
         self._peer_conns: Dict[str, RpcConnection] = {}
         self._tasks: List[asyncio.Task] = []
         self._shutdown = False
+        # Object spilling (reference raylet/local_object_manager.h:41).
+        self.spill_dir = os.path.join(
+            tempfile.gettempdir(), f"rt_spill_{node_id.hex()[:12]}")
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self._spill_lock = asyncio.Lock()
+        # Test hook: replaces /proc/meminfo reads in the memory monitor.
+        self._memory_usage_fn = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -115,6 +129,10 @@ class Raylet:
             self._reap_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._stuck_lease_watchdog()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._pressure_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._memory_monitor_loop()))
         return port
 
     async def close(self):
@@ -135,6 +153,8 @@ class Raylet:
         if self.gcs_conn:
             await self.gcs_conn.close()
         self.plasma.close()
+        import shutil
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
 
     async def _stuck_lease_watchdog(self):
         """Log scheduler state while leases sit queued — a queued lease
@@ -239,6 +259,17 @@ class Raylet:
                 for k, v in msg.get("bundle", {}).items():
                     self.resources_available[k] = \
                         self.resources_available.get(k, 0.0) + v
+            return {"ok": True}
+        if mtype == "delete_object":
+            # Owner freed it; drop our in-memory copy (no-op if pinned or
+            # already evicted).
+            self.plasma.delete(ObjectID.from_hex(msg["object_id"]))
+            return {"ok": True}
+        if mtype == "delete_spilled":
+            try:
+                os.unlink(self._spill_path(msg["object_id"]))
+            except OSError:
+                pass
             return {"ok": True}
         if mtype == "pub":
             return None
@@ -421,6 +452,7 @@ class Raylet:
         lease_id = os.urandom(8).hex()
         w.lease_id = lease_id
         w.busy = True
+        w.busy_since = time.monotonic()
         return {"worker_address": w.address, "lease_id": lease_id,
                 "worker_id": w.worker_id.hex(),
                 "resources": req.resources, "pg_id": req.pg_id,
@@ -471,14 +503,195 @@ class Raylet:
                 still_pending.append(req)
         self.pending_leases = still_pending
 
+    # -- object spilling (reference raylet/local_object_manager.h:41) --
+
+    def _spill_path(self, oid_hex: str) -> str:
+        return os.path.join(self.spill_dir, f"{oid_hex}.bin")
+
+    async def _pressure_loop(self):
+        """Spill cold plasma objects to disk past the high-water mark, down
+        to the low-water mark (reference: spilling triggered from the plasma
+        create path under memory pressure)."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            try:
+                st = self.plasma.stats()
+                if st["bytes_used"] > SPILL_HIGH_WATER * st["capacity"]:
+                    await self._spill_objects(
+                        int(st["bytes_used"] -
+                            SPILL_LOW_WATER * st["capacity"]))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("spill pressure check failed")
+
+    async def _spill_objects(self, want_bytes: int) -> int:
+        """Move up to want_bytes of GCS-tracked local plasma objects to
+        disk; returns bytes freed.  Pinned objects (readers hold a
+        refcount) are skipped — delete() refuses them."""
+        async with self._spill_lock:
+            freed = 0
+            try:
+                oids = await self.gcs_conn.request(
+                    {"type": "objects_on_node",
+                     "node_id": self.node_id.hex()})
+            except Exception:
+                return 0
+            for oid_hex in oids:
+                if freed >= want_bytes:
+                    break
+                oid = ObjectID.from_hex(oid_hex)
+                view = self.plasma.get(oid)
+                if view is None:
+                    continue
+                try:
+                    data = bytes(view)
+                finally:
+                    view.release()
+                    self.plasma.release(oid)
+                path = self._spill_path(oid_hex)
+
+                def _write(p=path, d=data):
+                    tmp = p + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(d)
+                    os.replace(tmp, p)
+
+                # Disk IO off the event loop: a multi-MB write must not
+                # stall heartbeats/leases (reference spills on an io worker
+                # pool for the same reason).
+                await asyncio.get_running_loop().run_in_executor(None,
+                                                                 _write)
+                if not self.plasma.delete(oid):
+                    os.unlink(path)  # pinned by a reader; keep in memory
+                    continue
+                await self.gcs_conn.request({
+                    "type": "object_spilled", "object_id": oid_hex,
+                    "node_id": self.node_id.hex(), "path": path})
+                freed += len(data)
+            if freed:
+                logger.info("spilled %d bytes to %s", freed, self.spill_dir)
+            return freed
+
+    async def _h_spill_request(self, conn, msg):
+        """A local worker's plasma create failed; make room synchronously."""
+        freed = await self._spill_objects(int(msg.get("bytes", 0)) or
+                                          TRANSFER_CHUNK)
+        return {"freed": freed}
+
+    async def _create_with_spill(self, oid: ObjectID, size: int):
+        """Allocate in plasma without evicting primary copies: make room by
+        spilling; LRU eviction is the very last resort (it can only be
+        reached when nothing is left to spill, so anything it takes is a
+        secondary copy or untracked)."""
+        from ray_tpu._private.plasma import ObjectStoreFullError
+        try:
+            return self.plasma.create(oid, size, allow_evict=False)
+        except ObjectStoreFullError:
+            await self._spill_objects(size)
+            try:
+                return self.plasma.create(oid, size, allow_evict=False)
+            except ObjectStoreFullError:
+                return self.plasma.create(oid, size)
+
+    async def _restore_spilled(self, oid: ObjectID) -> bool:
+        """Disk -> plasma (reference: LocalObjectManager restore path)."""
+        path = self._spill_path(oid.hex())
+        if not os.path.exists(path):
+            return False
+
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+
+        data = await asyncio.get_running_loop().run_in_executor(None, _read)
+        if not self.plasma.contains(oid):
+            buf = await self._create_with_spill(oid, len(data))
+            buf[:] = data
+            self.plasma.seal(oid)
+            self.plasma.release(oid)
+        await self.gcs_conn.request({
+            "type": "object_location_add", "object_id": oid.hex(),
+            "node_id": self.node_id.hex()})
+        os.unlink(path)
+        return True
+
+    # -- memory monitor / OOM killing (reference common/memory_monitor.h:52,
+    #    raylet/worker_killing_policy.h:30) --
+
+    @staticmethod
+    def system_memory_usage_fraction() -> float:
+        """Used fraction of system memory from /proc/meminfo (the reference
+        MemoryMonitor also prefers cgroup/proc over psutil)."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        info[parts[0].rstrip(":")] = int(parts[1])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_worker_to_kill(self) -> Optional[WorkerHandle]:
+        """Reference RetriableLIFOWorkerKillingPolicy: prefer retriable
+        leased task workers, newest first (their retry loses the least
+        work); never kill actors (their loss cascades) or idle workers
+        (killing them frees little and they are reaped separately)."""
+        leased = [w for w in self.workers.values()
+                  if w.busy and w.lease_id is not None
+                  and w.actor_id is None and w.proc.poll() is None]
+        if not leased:
+            return None
+        return max(leased, key=lambda w: w.busy_since)
+
+    async def _memory_monitor_loop(self):
+        threshold = float(os.environ.get("RT_MEMORY_USAGE_THRESHOLD", "0.97"))
+        usage_fn = self._memory_usage_fn or self.system_memory_usage_fraction
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            try:
+                usage = usage_fn()
+                if usage < threshold:
+                    continue
+                w = self._pick_worker_to_kill()
+                if w is None:
+                    continue
+                logger.warning(
+                    "memory monitor: usage %.1f%% >= %.1f%%; killing newest "
+                    "leased worker %s (task will be retried by its owner)",
+                    usage * 100, threshold * 100, w.worker_id.hex()[:8])
+                w.proc.kill()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("memory monitor failed")
+
     # -- object transfer (pull-based, reference object_manager/pull_manager) --
 
     async def _h_fetch_object(self, conn, msg):
-        """Serve an object from local plasma as chunked frames (push side)."""
+        """Serve an object from local plasma as chunked frames (push side).
+        Falls back to this node's spill file so a spilled copy stays
+        fetchable without forcing a restore into a full store."""
         oid = ObjectID.from_hex(msg["object_id"])
         view = self.plasma.get(oid)
         if view is None:
-            return {"found": False}
+            path = self._spill_path(msg["object_id"])
+            try:
+                total = os.path.getsize(path)
+                offset = msg.get("offset", 0)
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(TRANSFER_CHUNK)
+                return {"found": True, "total": total, "offset": offset,
+                        "data": data}
+            except OSError:
+                return {"found": False}
         try:
             total = len(view)
             offset = msg.get("offset", 0)
@@ -496,11 +709,17 @@ class Raylet:
             return {"ok": True}
         loc = await self.gcs_conn.request({"type": "object_locations_get",
                                            "object_id": msg["object_id"]})
-        if loc is None or not loc["nodes"]:
+        spilled = (loc or {}).get("spilled", {})
+        if loc is None or (not loc["nodes"] and not spilled):
             return {"ok": False, "error": "no locations"}
+        # Spilled on this very node: restore from the local disk file.
+        if self.node_id.hex() in spilled:
+            if await self._restore_spilled(oid):
+                return {"ok": True}
         nodes = await self.gcs_conn.request({"type": "get_nodes"})
+        holders = set(loc["nodes"]) | set(spilled)
         candidates = [n["address"] for n in nodes
-                      if n["node_id"] in loc["nodes"] and n["alive"] and
+                      if n["node_id"] in holders and n["alive"] and
                       n["node_id"] != self.node_id.hex()]
         if not candidates:
             return {"ok": False, "error": "no live remote location"}
@@ -525,7 +744,7 @@ class Raylet:
         total = first["total"]
         if self.plasma.contains(oid):
             return {"ok": True}
-        buf = self.plasma.create(oid, total)
+        buf = await self._create_with_spill(oid, total)
         try:
             data = first["data"]
             buf[0:len(data)] = data

@@ -56,7 +56,8 @@ class Cluster:
                     resources: Optional[Dict[str, float]] = None,
                     object_store_memory: int = 256 * 1024 * 1024,
                     env: Optional[Dict[str, str]] = None,
-                    labels: Optional[Dict[str, str]] = None) -> ClusterNode:
+                    labels: Optional[Dict[str, str]] = None,
+                    gcs_persist_path: Optional[str] = None) -> ClusterNode:
         ready_file = os.path.join(
             tempfile.gettempdir(),
             f"rt_node_{os.getpid()}_{uuid.uuid4().hex[:8]}.json")
@@ -69,6 +70,8 @@ class Cluster:
                "--no-tpu-detect"]
         if labels:
             cmd += ["--labels", json.dumps(labels)]
+        if gcs_persist_path:
+            cmd += ["--gcs-persist-path", gcs_persist_path]
         if head:
             cmd.append("--head")
         else:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import builtins
 import random as _random
+import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import numpy as np
@@ -128,17 +129,42 @@ def _fused_stages(stages, block):
 
 class ActorPoolStrategy:
     """compute= strategy running stages on a pool of reusable actors
-    (reference _internal/compute.py:179)."""
+    (reference _internal/compute.py:179 -- min_size/max_size bounds; the
+    pool is sized to min(max_size, num_blocks))."""
 
-    def __init__(self, size: int = 2):
-        self.size = size
+    def __init__(self, size: Optional[int] = None, *, min_size: int = 1,
+                 max_size: Optional[int] = None):
+        if size is not None:
+            min_size = max_size = size
+        self.min_size = min_size
+        self.max_size = max_size if max_size is not None else max(min_size, 2)
+
+    @property
+    def size(self) -> int:
+        return self.max_size
 
 
 class _StageActor:
-    """Reusable executor for actor-pool stages."""
+    """Reusable executor for actor-pool stages.
+
+    A *callable class* stage fn (reference: map_batches "callable class"
+    with ActorPoolStrategy) is instantiated once per actor, keyed by stage
+    token, so expensive per-process state (a loaded model, a jit cache)
+    survives across blocks — this is what BatchPredictor rides on."""
+
+    def __init__(self):
+        self._instances = {}
 
     def run(self, kernel, fn, block, *extra):
         return kernel(fn, block, *extra)
+
+    def run_stateful(self, token, kernel, fn_cls, ctor_args, ctor_kwargs,
+                     block, *extra):
+        inst = self._instances.get(token)
+        if inst is None:
+            inst = self._instances[token] = fn_cls(*ctor_args,
+                                                   **(ctor_kwargs or {}))
+        return kernel(inst, block, *extra)
 
 
 class Dataset:
@@ -199,17 +225,31 @@ class Dataset:
                 "size_bytes": self.size_bytes()}
 
     # -- transforms -------------------------------------------------------
-    def _run_stage(self, kernel, fn, compute=None, extra=()) -> "Dataset":
+    def _run_stage(self, kernel, fn, compute=None, extra=(),
+                   fn_constructor_args=(), fn_constructor_kwargs=None
+                   ) -> "Dataset":
+        if isinstance(fn, type) and not isinstance(compute,
+                                                   ActorPoolStrategy):
+            raise ValueError(
+                "callable-class stage functions require "
+                "compute=ActorPoolStrategy(...) (they hold per-actor state)")
         if isinstance(compute, ActorPoolStrategy):
             # Actor stages execute eagerly (they hold process state, e.g. a
             # loaded model, so they can't ride the fused-task path).
             blocks = self._execute()
             pool_cls = ray_tpu.remote(_StageActor)
-            pool = [pool_cls.remote()
-                    for _ in builtins.range(min(compute.size,
-                                                len(blocks)) or 1)]
-            refs = [pool[i % len(pool)].run.remote(kernel, fn, b, *extra)
-                    for i, b in enumerate(blocks)]
+            n_actors = max(compute.min_size,
+                           min(compute.max_size, len(blocks)) or 1)
+            pool = [pool_cls.remote() for _ in builtins.range(n_actors)]
+            if isinstance(fn, type):
+                token = uuid.uuid4().hex
+                refs = [pool[i % len(pool)].run_stateful.remote(
+                            token, kernel, fn, tuple(fn_constructor_args),
+                            fn_constructor_kwargs, b, *extra)
+                        for i, b in enumerate(blocks)]
+            else:
+                refs = [pool[i % len(pool)].run.remote(kernel, fn, b, *extra)
+                        for i, b in enumerate(blocks)]
             out = Dataset(refs)
             out._actor_pool = pool  # keep alive until ds collected
             return out
@@ -230,9 +270,12 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = 4096,
                     batch_format: str = "numpy",
-                    compute=None) -> "Dataset":
+                    compute=None, fn_constructor_args=(),
+                    fn_constructor_kwargs=None) -> "Dataset":
         return self._run_stage(_map_batches_block, fn, compute,
-                               extra=(batch_size, batch_format))
+                               extra=(batch_size, batch_format),
+                               fn_constructor_args=fn_constructor_args,
+                               fn_constructor_kwargs=fn_constructor_kwargs)
 
     # -- reshaping --------------------------------------------------------
     def _rechunk(self, sizes: List[int]) -> "Dataset":

@@ -72,7 +72,9 @@ def cmd_start(args):
     if resources:
         cmd += ["--resources", json.dumps(resources)]
     if args.head:
-        cmd += ["--head", "--gcs-port", str(args.port)]
+        cmd += ["--head", "--gcs-port", str(args.port),
+                "--gcs-persist-path",
+                os.path.join(SESSION_DIR, "gcs_snapshot.json")]
     else:
         address = args.address or sess.get("gcs_address")
         if not address:
@@ -136,6 +138,14 @@ def cmd_stop(args):
             except ProcessLookupError:
                 pass
     _save_session({"nodes": []})
+    # A deliberate stop is a clean teardown: drop the GCS snapshot so the
+    # next `start --head` is a fresh cluster, not a resurrection of the old
+    # one's detached actors/jobs/KV. (Crash recovery keeps the snapshot
+    # because the daemon dies without coming through here.)
+    try:
+        os.unlink(os.path.join(SESSION_DIR, "gcs_snapshot.json"))
+    except OSError:
+        pass
     print(f"stopped {stopped} node daemon(s)")
 
 

@@ -11,16 +11,21 @@ pjit step over ICI.
 
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
+from ray_tpu.train.batch_predictor import BatchPredictor
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 from ray_tpu.train.jax.config import JaxConfig
 from ray_tpu.train.jax.jax_trainer import JaxTrainer
+from ray_tpu.train.predictor import JaxPredictor, Predictor
 
 __all__ = [
     "Backend",
     "BackendConfig",
     "BaseTrainer",
     "TrainingFailedError",
+    "BatchPredictor",
     "DataParallelTrainer",
     "JaxConfig",
     "JaxTrainer",
+    "JaxPredictor",
+    "Predictor",
 ]
