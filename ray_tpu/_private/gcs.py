@@ -196,7 +196,8 @@ class GcsServer:
             "jobs": self.jobs,
             "named_actors": [
                 [ns, name, aid.hex()]
-                for (ns, name), aid in self.named_actors.items()],
+                for (ns, name), aid in self.named_actors.items()
+                if aid in self.actors and self.actors[aid].state != DEAD],
             "actors": [
                 {"actor_id": a.actor_id.hex(), "name": a.name,
                  "namespace": a.namespace,
@@ -204,7 +205,10 @@ class GcsServer:
                  "resources": a.resources, "max_restarts": a.max_restarts,
                  "num_restarts": a.num_restarts, "detached": a.detached,
                  "scheduling": a.scheduling}
-                for a in self.actors.values() if a.detached],
+                # DEAD stays dead across restarts: a ray.kill'ed detached
+                # actor must not resurrect from the snapshot.
+                for a in self.actors.values()
+                if a.detached and a.state != DEAD],
             "placement_groups": [
                 {"pg_id": pg.pg_id.hex(), "bundles": pg.bundles,
                  "strategy": pg.strategy}
@@ -218,6 +222,21 @@ class GcsServer:
             json.dump(self._snapshot_state(), f)
         _os.replace(tmp, self._persist_path)
         self._dirty = False
+
+    async def _write_snapshot_async(self):
+        """Snapshot without stalling the event loop: the state dict is
+        built synchronously (no awaits — consistent view), but the JSON
+        encode + disk write of a potentially-large KV run on the executor."""
+        state = self._snapshot_state()
+        self._dirty = False
+
+        def _dump():
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            _os.replace(tmp, self._persist_path)
+
+        await asyncio.get_running_loop().run_in_executor(None, _dump)
 
     def _load_snapshot(self):
         import base64
@@ -263,7 +282,7 @@ class GcsServer:
             if not self._dirty:
                 continue
             try:
-                self._write_snapshot()
+                await self._write_snapshot_async()
             except Exception:
                 logger.exception("GCS snapshot write failed")
 
@@ -274,7 +293,7 @@ class GcsServer:
 
     # Message types that change durable state (snapshot triggers).
     _DURABLE_MUTATIONS = frozenset({
-        "kv_put", "kv_del", "register_actor", "create_actor", "kill_actor",
+        "kv_put", "kv_del", "create_actor", "kill_actor",
         "report_actor_death", "register_job", "finish_job",
         "create_placement_group", "remove_placement_group"})
 
@@ -579,6 +598,9 @@ class GcsServer:
                 await self._schedule_pg(pg)
 
     async def _on_actor_failure(self, actor: ActorInfo, reason: str):
+        # Restart counts / DEAD transitions from the health loop mutate
+        # durable state outside any RPC handler.
+        self._dirty = True
         node = self.nodes.get(actor.node_id) if actor.node_id else None
         if node is not None and node.alive:
             for k, v in actor.resources.items():
@@ -853,11 +875,13 @@ class GcsServer:
 
     async def _h_object_spilled(self, conn, msg):
         """A node moved its in-memory copy to disk (reference:
-        LocalObjectManager::SpillObjects reporting spilled URLs)."""
+        LocalObjectManager::SpillObjects reporting spilled URLs).  An
+        unknown object means the owner freed it while the spill was in
+        flight — refuse, so the raylet deletes the orphan file instead of
+        resurrecting a freed entry."""
         entry = self.object_dir.get(msg["object_id"])
         if entry is None:
-            entry = self.object_dir[msg["object_id"]] = ObjectDirEntry(
-                msg.get("owner", ""))
+            return {"ok": False}
         entry.spilled[msg["node_id"]] = msg["path"]
         entry.nodes.discard(msg["node_id"])
         return {"ok": True}

@@ -563,11 +563,24 @@ class Raylet:
                 await asyncio.get_running_loop().run_in_executor(None,
                                                                  _write)
                 if not self.plasma.delete(oid):
-                    os.unlink(path)  # pinned by a reader; keep in memory
-                    continue
-                await self.gcs_conn.request({
+                    if self.plasma.contains(oid):
+                        os.unlink(path)  # pinned by a reader; stays in memory
+                        continue
+                    # delete()==False with the object absent means it was
+                    # concurrently LRU-evicted during the disk write — the
+                    # file we just wrote is now the only copy; keep it and
+                    # register the spill location.
+                reply = await self.gcs_conn.request({
                     "type": "object_spilled", "object_id": oid_hex,
                     "node_id": self.node_id.hex(), "path": path})
+                if not reply.get("ok"):
+                    # Raced an object_freed: the owner dropped the object
+                    # while we were spilling it; the file is garbage.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
                 freed += len(data)
             if freed:
                 logger.info("spilled %d bytes to %s", freed, self.spill_dir)

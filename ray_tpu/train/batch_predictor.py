@@ -21,14 +21,11 @@ class _ScoringWrapper:
     """Callable class instantiated once per scoring actor; holds the
     restored predictor (reference batch_predictor.py ScoringWrapper)."""
 
-    def __init__(self, predictor_cls, checkpoint_ref: Dict,
+    def __init__(self, predictor_cls, checkpoint_data: Dict,
                  predictor_kwargs: Dict, feature_columns, keep_columns,
                  prediction_column: str):
-        checkpoint = (Checkpoint.from_dict(checkpoint_ref["data"])
-                      if "data" in checkpoint_ref
-                      else Checkpoint.from_directory(checkpoint_ref["path"]))
         self._predictor = predictor_cls.from_checkpoint(
-            checkpoint, **predictor_kwargs)
+            Checkpoint.from_dict(checkpoint_data), **predictor_kwargs)
         self._feature_columns = feature_columns
         self._keep_columns = keep_columns
         self._prediction_column = prediction_column
@@ -79,13 +76,13 @@ class BatchPredictor:
         # Ship the checkpoint by value: a directory checkpoint's local path
         # does not exist on remote nodes, so materialize it to a dict
         # (to_dict handles both forms).
-        ckpt_ref = {"data": self._checkpoint.to_dict()}
         return dataset.map_batches(
             _ScoringWrapper,
             batch_size=batch_size,
             compute=ActorPoolStrategy(min_size=min_scoring_workers,
                                       max_size=max_scoring_workers),
-            fn_constructor_args=(self._predictor_cls, ckpt_ref,
+            fn_constructor_args=(self._predictor_cls,
+                                 self._checkpoint.to_dict(),
                                  self._predictor_kwargs, feature_columns,
                                  keep_columns, prediction_column),
         )

@@ -1,10 +1,13 @@
 from ray_tpu.tune.schedulers.trial_scheduler import (
     FIFOScheduler, TrialScheduler)
 from ray_tpu.tune.schedulers.asha import ASHAScheduler
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+from ray_tpu.tune.schedulers.pb2 import PB2
 
 __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "PB2",
 ]

@@ -1,4 +1,7 @@
 from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.bayesopt import BayesOptSearcher
+from ray_tpu.tune.search.tpe import TPESearcher
 
-__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator"]
+__all__ = ["Searcher", "ConcurrencyLimiter", "BasicVariantGenerator",
+           "BayesOptSearcher", "TPESearcher"]

@@ -68,3 +68,36 @@ class ConcurrencyLimiter(Searcher):
     @property
     def total_suggestions(self):
         return self.searcher.total_suggestions
+
+
+class BudgetedSearcher(Searcher):
+    """Caps an open-ended searcher (TPE/GP suggest forever) at
+    ``num_samples`` trials — the reference applies num_samples to any
+    search_alg the same way (tune.run num_samples semantics)."""
+
+    def __init__(self, searcher: Searcher, max_trials: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_trials = max_trials
+        self._issued = 0
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        if self._issued >= self.max_trials:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._issued += 1
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    @property
+    def total_suggestions(self):
+        return self.max_trials

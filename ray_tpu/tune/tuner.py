@@ -66,6 +66,11 @@ class Tuner:
         elif isinstance(searcher, Searcher):
             searcher.set_search_properties(tc.metric, tc.mode or "max",
                                            self._param_space)
+            # Open-ended searchers (TPE/GP) honor num_samples like the
+            # reference: cap total suggestions.
+            if tc.num_samples and searcher.total_suggestions is None:
+                from ray_tpu.tune.search.searcher import BudgetedSearcher
+                searcher = BudgetedSearcher(searcher, tc.num_samples)
 
         name = self._run_config.name or "tune_experiment"
         storage = self._run_config.storage_path

@@ -5,10 +5,15 @@ past capacity, everything stays readable via disk) and
 raylet/worker_killing_policy.h (retriable-LIFO kill selection).
 """
 
+import glob
+import os
 import subprocess
+import tempfile
 import time
 from dataclasses import dataclass
 from typing import Optional
+
+_SPILL_GLOB = os.path.join(tempfile.gettempdir(), "rt_spill_*", "*.bin")
 
 import numpy as np
 import pytest
@@ -65,21 +70,20 @@ def test_task_returns_spill_too(small_store_cluster):
 def test_freed_spilled_objects_release_disk(small_store_cluster):
     """Dropping the last reference to a spilled object deletes its spill
     file and directory entry (no unbounded disk growth)."""
-    import glob
     mb8 = 8 * 1024 * 1024 // 8
     refs = [ray_tpu.put(np.full(mb8, float(i))) for i in range(12)]
     assert any(o.get("spilled") for o in state.list_objects())
-    n_files_before = len(glob.glob("/tmp/rt_spill_*/*.bin"))
+    n_files_before = len(glob.glob(_SPILL_GLOB))
     assert n_files_before > 0
     del refs
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
-        files = len(glob.glob("/tmp/rt_spill_*/*.bin"))
+        files = len(glob.glob(_SPILL_GLOB))
         entries = len(state.list_objects())
         if files == 0 and entries == 0:
             break
         time.sleep(0.5)
-    assert len(glob.glob("/tmp/rt_spill_*/*.bin")) == 0
+    assert len(glob.glob(_SPILL_GLOB)) == 0
     assert state.list_objects() == []
 
 

@@ -1,0 +1,173 @@
+"""TPE + GP Bayesian searchers and the HyperBand scheduler.
+
+Reference analogs: tune/tests/test_searchers.py (searchers find better
+optima than random on a known function) and tests/test_trial_scheduler.py
+(HyperBand rung selection).
+"""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import HyperBandScheduler
+from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearcher,
+                                 TPESearcher)
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray_tpu.init(num_cpus=4, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield
+    ray_tpu.shutdown()
+
+
+def _drive(searcher, objective, space, n=40):
+    """Offline suggest/complete loop; returns (best, all values in order)."""
+    searcher.set_search_properties("obj", "max", space)
+    vals = []
+    for i in range(n):
+        cfg = searcher.suggest(f"t{i}")
+        v = objective(cfg)
+        searcher.on_trial_complete(f"t{i}", {"obj": v})
+        vals.append(v)
+    return max(vals), vals
+
+
+def _quadratic(cfg):
+    # Max 0.0 at x=0.3, y=0.7.
+    return -((cfg["x"] - 0.3) ** 2 + (cfg["y"] - 0.7) ** 2)
+
+
+def test_tpe_concentrates_on_quadratic_optimum():
+    """TPE's model-phase suggestions cluster near the optimum: the average
+    of its last 10 suggestions beats the average of a uniform-random
+    searcher by a wide margin (a single lucky random draw can tie the best,
+    so the concentration of mass is what distinguishes the model)."""
+    space = {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)}
+    tpe_best, tpe_vals = _drive(TPESearcher(seed=0, n_startup_trials=8),
+                                _quadratic, dict(space))
+    _, rnd_vals = _drive(BasicVariantGenerator(num_samples=40, seed=0),
+                         _quadratic, dict(space))
+    tail_mean = sum(tpe_vals[-10:]) / 10
+    rnd_mean = sum(rnd_vals) / len(rnd_vals)
+    assert tail_mean > rnd_mean + 0.05
+    assert tpe_best > -0.01  # found the basin
+
+
+def test_bayesopt_finds_quadratic_optimum():
+    space = {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)}
+    gp_best, _ = _drive(BayesOptSearcher(seed=0, n_startup_trials=6),
+                        _quadratic, dict(space))
+    assert gp_best > -0.01
+
+
+def test_tpe_handles_mixed_space():
+    space = {"lr": tune.loguniform(1e-5, 1e-1),
+             "layers": tune.randint(1, 8),
+             "act": tune.choice(["relu", "gelu", "tanh"]),
+             "nested": {"dropout": tune.uniform(0.0, 0.5)}}
+
+    def obj(cfg):
+        import math
+        score = -abs(math.log10(cfg["lr"]) + 3)           # best lr 1e-3
+        score += -abs(cfg["layers"] - 4) * 0.1            # best layers 4
+        score += 0.5 if cfg["act"] == "gelu" else 0.0
+        score += -abs(cfg["nested"]["dropout"] - 0.1)
+        return score
+
+    s = TPESearcher(seed=1, n_startup_trials=10)
+    best, _ = _drive(s, obj, space, n=60)
+    assert best > -1.0
+    # Model-phase suggestions concentrate on the good categorical arm.
+    cfg = s.suggest("probe")
+    assert cfg["act"] == "gelu"
+
+
+def test_bayesopt_respects_integer_and_log_domains():
+    s = BayesOptSearcher(seed=2, n_startup_trials=4)
+    space = {"n": tune.randint(2, 64), "lr": tune.loguniform(1e-5, 1e-1)}
+
+    def obj(cfg):
+        assert isinstance(cfg["n"], int) and 2 <= cfg["n"] < 64
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        return -abs(cfg["n"] - 32) / 32.0
+
+    best, _ = _drive(s, obj, space, n=25)
+    assert best > -0.2
+
+
+def _iterative(config):
+    v = 0.0
+    for _ in range(20):
+        v += config["rate"]
+        tune.report({"value": v})
+
+
+def test_hyperband_stops_bracket_losers(ray_start):
+    scheduler = HyperBandScheduler(max_t=18, reduction_factor=3)
+    tuner = Tuner(
+        _iterative,
+        param_space={"rate": tune.grid_search(
+            [0.01, 0.02, 0.03, 1.0, 2.0, 3.0])},
+        tune_config=TuneConfig(metric="value", mode="max",
+                               scheduler=scheduler,
+                               max_concurrent_trials=6),
+    )
+    results = tuner.fit()
+    iters = {r.metrics["config"]["rate"]:
+             r.metrics.get("training_iteration", 0) for r in results}
+    assert len(iters) == 6
+    # The strongest rates survive to the cap; weak ones die at a rung.
+    assert iters[3.0] >= 18 or iters[2.0] >= 18
+    assert min(iters.values()) < 18
+
+
+def test_tuner_with_tpe_search_alg(ray_start):
+    def trainable(config):
+        tune.report({"score": _quadratic(config)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0),
+                     "y": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               search_alg=TPESearcher(seed=3),
+                               num_samples=12, max_concurrent_trials=4),
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    best = results.get_best_result()
+    assert best.metrics["score"] > -0.5
+
+
+def _pb2_fn(config):
+    # Reward rate equals lr closeness to 0.5; checkpointable scalar state.
+    v = 0.0
+    for _ in range(30):
+        v += 1.0 - abs(config["lr"] - 0.5)
+        tune.report({"value": v})
+
+
+def test_pb2_learns_good_lr(ray_start):
+    from ray_tpu.tune.schedulers import PB2
+    scheduler = PB2(
+        time_attr="training_iteration", perturbation_interval=5,
+        hyperparam_mutations={"lr": tune.uniform(0.0, 1.0)},
+        min_observations=4, seed=0)
+    tuner = Tuner(
+        _pb2_fn,
+        param_space={"lr": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="value", mode="max",
+                               scheduler=scheduler, num_samples=4,
+                               max_concurrent_trials=4, seed=0),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    # 30 steps of perfect lr=0.5 gives 30; random-lr population without
+    # exploitation averages much lower. Loose floor: PB2 exploit+GP explore
+    # moved the population toward good lr.
+    assert best.metrics["value"] > 20.0
