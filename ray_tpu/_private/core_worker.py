@@ -819,6 +819,33 @@ class CoreWorker:
                      spec["name"])
         raylet = self.raylet
         lease_msg = {"type": "lease_worker", "resources": resources}
+        if scheduling.get("node_id"):
+            # NodeAffinitySchedulingStrategy (reference
+            # scheduling_strategies.py:41): lease from that node's raylet;
+            # hard affinity fails if the node is gone, soft falls back to
+            # the local raylet.
+            nodes = await self.gcs.request({"type": "get_nodes"})
+            target = next((n for n in nodes
+                           if n["node_id"] == scheduling["node_id"] and
+                           n["alive"]), None)
+            if target is not None:
+                raylet = await self._get_worker_conn(target["address"])
+                lease_msg["no_spill"] = not scheduling.get("soft", False)
+            elif not scheduling.get("soft", False):
+                raise rex.SchedulingError(
+                    f"node {scheduling['node_id'][:16]} required by "
+                    f"NodeAffinity is not alive")
+        elif scheduling.get("strategy") == "SPREAD":
+            # SPREAD (reference spread_scheduling_policy.h): round-robin
+            # over alive nodes whose capacity fits the request.
+            nodes = [n for n in await self.gcs.request({"type": "get_nodes"})
+                     if n["alive"] and all(
+                         n["resources_total"].get(k, 0.0) >= v
+                         for k, v in resources.items() if v > 0)]
+            if nodes:
+                self._spread_idx = getattr(self, "_spread_idx", 0) + 1
+                target = nodes[self._spread_idx % len(nodes)]
+                raylet = await self._get_worker_conn(target["address"])
         if scheduling.get("placement_group_id"):
             lease_msg["pg_id"] = scheduling["placement_group_id"]
             lease_msg["bundle_index"] = scheduling.get("bundle_index", 0) or 0
@@ -837,15 +864,28 @@ class CoreWorker:
                         raylet = await self._get_worker_conn(n["address"])
                         break
         grant = await raylet.request(lease_msg, timeout=600)
+        grant_conn = raylet   # the raylet that actually granted the lease
+        visited = []
         for _ in range(8):
             if "spillback" not in grant:
                 break
+            visited.append(grant["spillback"])
+            lease_msg["exclude"] = visited
             spill_conn = await self._get_worker_conn(grant["spillback"])
+            if len(visited) == 8:
+                # Hop budget exhausted (stale availability views chasing a
+                # saturated cluster): stop spilling and QUEUE at the final
+                # node — transient saturation must wait, not fail.
+                lease_msg["no_spill"] = True
             grant = await spill_conn.request(lease_msg, timeout=600)
+            grant_conn = spill_conn
         if "spillback" in grant:
             raise RuntimeError("lease spillback loop did not converge")
         worker_conn = await self._get_worker_conn(grant["worker_address"])
-        lease_raylet = raylet
+        # Leases MUST return to their granting raylet: returning to the
+        # original one after a spillback would free resources that were
+        # never taken there and leak them on the grantor.
+        lease_raylet = grant_conn
         crashed = False
         try:
             logger.debug("task %s: pushing to %s", spec["task_id"][:8],

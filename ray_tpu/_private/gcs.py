@@ -723,10 +723,40 @@ class GcsServer:
         finally:
             pg.scheduling_in_progress = False
 
+    @staticmethod
+    def _slice_of(resources: Dict[str, float]) -> Optional[str]:
+        for k in resources:
+            if k.startswith("tpu-slice:"):
+                return k
+        return None
+
+    def _pg_node_order(self, pg: PlacementGroupInfo,
+                       avail: Dict[NodeID, Dict[str, float]]) -> List[NodeID]:
+        """Candidate order for bundle packing.  TPU bundles get ICI-aware
+        ordering: hosts of the same slice are contiguous, slices ranked by
+        free TPU, so PACK fills one slice (ICI-connected) before touching
+        another — collectives ride ICI, not DCN (SURVEY hard part (b);
+        reference has no TPU notion, its BundleSchedulingPolicy is flat)."""
+        wants_tpu = any(b.get("TPU", 0) > 0 for b in pg.bundles)
+        if not wants_tpu:
+            return sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+        slice_free: Dict[Optional[str], float] = {}
+        for nid, res in avail.items():
+            s = self._slice_of(res)
+            slice_free[s] = slice_free.get(s, 0.0) + res.get("TPU", 0.0)
+        return sorted(
+            avail,
+            key=lambda nid: (
+                # Slices with the most free TPU first; sliceless hosts last.
+                -(slice_free.get(self._slice_of(avail[nid]), 0.0)),
+                self._slice_of(avail[nid]) or "~",   # group slice hosts
+                -avail[nid].get("TPU", 0.0),
+                -sum(avail[nid].values())))
+
     async def _schedule_pg_inner(self, pg: PlacementGroupInfo):
         avail = {n.node_id: dict(n.resources_available)
                  for n in self.nodes.values() if n.alive}
-        order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+        order = self._pg_node_order(pg, avail)
         placement: Dict[int, NodeID] = {}
 
         def fits(nid, bundle):
@@ -754,9 +784,14 @@ class GcsServer:
                 take(chosen, bundle)
         else:  # SPREAD / STRICT_SPREAD
             used: Set[NodeID] = set()
+            rank_of = {nid: i for i, nid in enumerate(order)}
             for i, bundle in enumerate(pg.bundles):
+                # Prefer unused nodes, but keep _pg_node_order's ranking
+                # (ICI slice grouping for TPU bundles) as the tiebreaker —
+                # re-sorting by raw free-resource sums would scatter TPU
+                # bundles across slices.
                 ranked = sorted(order, key=lambda nid: (nid in used,
-                                                        -sum(avail[nid].values())))
+                                                        rank_of[nid]))
                 chosen = None
                 for nid in ranked:
                     if pg.strategy == "STRICT_SPREAD" and nid in used:

@@ -247,6 +247,9 @@ class Raylet:
             for k, v in msg["bundle"].items():
                 self.resources_available[k] = \
                     self.resources_available.get(k, 0.0) - v
+            # PG leases that raced ahead of this push are queued; the new
+            # bundle pool may satisfy them now.
+            asyncio.get_running_loop().create_task(self._dispatch_leases())
             return {"ok": True}
         if mtype == "return_bundle":
             key = (msg["pg_id"], msg["bundle_index"])
@@ -412,9 +415,40 @@ class Raylet:
 
     def _feasible_ever(self, req: LeaseRequest) -> bool:
         if req.pg_id is not None:
-            return (req.pg_id, req.bundle_index) in self.bundles or True
+            return (req.pg_id, req.bundle_index) in self.bundles
         return all(self.resources_total.get(k, 0.0) >= v
                    for k, v in req.resources.items() if v > 0)
+
+    async def _get_nodes_cached(self) -> list:
+        """GCS node view, cached for one heartbeat period: spill scoring on
+        a saturated node must not add a GCS round-trip per lease (the view
+        is ~0.5s stale either way)."""
+        now = time.monotonic()
+        ts, nodes = getattr(self, "_node_view_cache", (0.0, None))
+        if nodes is None or now - ts > 0.5:
+            nodes = await self.gcs_conn.request({"type": "get_nodes"})
+            self._node_view_cache = (now, nodes)
+        return nodes
+
+    def _score_spill_target(self, n: dict, resources: Dict[str, float],
+                            by_avail: bool) -> Optional[float]:
+        """Reference scorer (scheduling/policy/scorer.cc): lowest
+        post-placement utilization wins.  Returns None if the node can't
+        take the request (by availability or, for by_avail=False, by
+        capacity)."""
+        pool = n["resources_available"] if by_avail else n["resources_total"]
+        for k, v in resources.items():
+            if v > 0 and pool.get(k, 0.0) < v:
+                return None
+        util = 0.0
+        for k, total in n["resources_total"].items():
+            if total <= 0:
+                continue
+            used = total - n["resources_available"].get(k, 0.0)
+            if k in resources:
+                used += resources[k]
+            util = max(util, used / total)
+        return -util  # higher score = lower utilization
 
     async def _h_lease_worker(self, conn, msg):
         req = LeaseRequest(
@@ -424,15 +458,58 @@ class Raylet:
             future=asyncio.get_running_loop().create_future(),
         )
         if not self._fits(req):
+            # Hybrid policy (reference hybrid_scheduling_policy.h:24-47):
+            # local-first, but a saturated node forwards work to a node
+            # with free capacity instead of queueing the whole cluster
+            # behind one host.  `exclude` carries already-visited nodes so
+            # stale availability can't ping-pong a lease forever.
+            exclude = set(msg.get("exclude", [])) | {self.server.address}
+            if req.pg_id is not None:
+                # PG leases never spill: the bundle lives here or the
+                # allocation moved.  A missing bundle whose GCS allocation
+                # still points here is a reserve_bundle push in flight —
+                # queue; anywhere else is a stale allocation — fail fast so
+                # the submitter re-resolves instead of hanging.
+                if not self._feasible_ever(req):
+                    pg = await self.gcs_conn.request(
+                        {"type": "get_placement_group",
+                         "pg_id": req.pg_id})
+                    allocated_here = pg is not None and \
+                        self.node_id.hex() in (
+                            pg["allocations"].get(req.bundle_index),
+                            pg["allocations"].get(str(req.bundle_index)))
+                    if not allocated_here:
+                        raise RuntimeError(
+                            f"bundle {req.bundle_index} of pg "
+                            f"{req.pg_id[:16]} is not on this node")
+                self.pending_leases.append(req)
+                return await req.future
+            if msg.get("no_spill"):
+                # Hard node affinity, or the end of a spillback chain:
+                # run here or wait here.
+                if not self._feasible_ever(req):
+                    raise RuntimeError(
+                        f"this node can never satisfy {req.resources}")
+                self.pending_leases.append(req)
+                return await req.future
+            nodes = await self._get_nodes_cached()
+            scored = [
+                (score, n["address"]) for n in nodes
+                if n["alive"] and n["address"] not in exclude and
+                (score := self._score_spill_target(
+                    n, req.resources, by_avail=True)) is not None]
+            if scored:
+                return {"spillback": max(scored)[1]}
             if not self._feasible_ever(req):
-                # Never feasible locally -> spillback to a node that fits.
-                nodes = await self.gcs_conn.request({"type": "get_nodes"})
-                for n in nodes:
-                    if n["alive"] and all(
-                        n["resources_total"].get(k, 0.0) >= v
-                        for k, v in req.resources.items() if v > 0
-                    ) and n["address"] != self.server.address:
-                        return {"spillback": n["address"]}
+                # Never feasible here and nothing free now: forward to any
+                # node whose total capacity fits, else fail fast.
+                scored = [
+                    (score, n["address"]) for n in nodes
+                    if n["alive"] and n["address"] not in exclude and
+                    (score := self._score_spill_target(
+                        n, req.resources, by_avail=False)) is not None]
+                if scored:
+                    return {"spillback": max(scored)[1]}
                 raise RuntimeError(
                     f"no node in the cluster can ever satisfy {req.resources}")
             self.pending_leases.append(req)

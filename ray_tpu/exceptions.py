@@ -61,5 +61,10 @@ class RuntimeEnvSetupError(RayTpuError):
     pass
 
 
+class SchedulingError(RayTpuError):
+    """No node can satisfy the request's scheduling constraints
+    (reference: TaskUnschedulableError)."""
+
+
 class PlacementGroupUnavailableError(RayTpuError):
     pass
