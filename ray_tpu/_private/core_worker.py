@@ -814,17 +814,32 @@ class CoreWorker:
         for oid in return_ids:
             self._store_local(oid.hex(), "err", payload)
 
+    async def _get_nodes_cached(self) -> list:
+        """GCS node view cached for one heartbeat period — SPREAD/affinity
+        submissions must not pay a GCS round-trip per task (the view is
+        ~0.5s stale either way; same rationale as the raylet-side cache)."""
+        import time as _time
+        now = _time.monotonic()
+        ts, nodes = getattr(self, "_node_view_cache", (0.0, None))
+        if nodes is None or now - ts > 0.5:
+            nodes = await self.gcs.request({"type": "get_nodes"})
+            self._node_view_cache = (now, nodes)
+        return nodes
+
     async def _submit_once(self, spec, resources, scheduling) -> dict:
         logger.debug("task %s %s: leasing", spec["task_id"][:8],
                      spec["name"])
         raylet = self.raylet
         lease_msg = {"type": "lease_worker", "resources": resources}
+        if scheduling.get("runtime_env"):
+            lease_msg["runtime_env"] = scheduling["runtime_env"]
+            lease_msg["env_key"] = scheduling.get("env_key", "")
         if scheduling.get("node_id"):
             # NodeAffinitySchedulingStrategy (reference
             # scheduling_strategies.py:41): lease from that node's raylet;
             # hard affinity fails if the node is gone, soft falls back to
             # the local raylet.
-            nodes = await self.gcs.request({"type": "get_nodes"})
+            nodes = await self._get_nodes_cached()
             target = next((n for n in nodes
                            if n["node_id"] == scheduling["node_id"] and
                            n["alive"]), None)
@@ -838,7 +853,7 @@ class CoreWorker:
         elif scheduling.get("strategy") == "SPREAD":
             # SPREAD (reference spread_scheduling_policy.h): round-robin
             # over alive nodes whose capacity fits the request.
-            nodes = [n for n in await self.gcs.request({"type": "get_nodes"})
+            nodes = [n for n in await self._get_nodes_cached()
                      if n["alive"] and all(
                          n["resources_total"].get(k, 0.0) >= v
                          for k, v in resources.items() if v > 0)]

@@ -558,6 +558,7 @@ class GcsServer:
                 "resources": actor.resources,
                 "pg_id": actor.scheduling.get("placement_group_id"),
                 "bundle_index": actor.scheduling.get("bundle_index", 0) or 0,
+                "runtime_env": actor.scheduling.get("runtime_env"),
             }, timeout=240)
             actor.address = reply["address"]
             actor.state = ALIVE

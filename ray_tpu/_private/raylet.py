@@ -22,6 +22,7 @@ submitter retries there (hybrid_scheduling_policy.h's local-first behavior).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import subprocess
@@ -57,8 +58,10 @@ class WorkerHandle:
     lease_id: Optional[str] = None
     busy: bool = False
     busy_since: float = 0.0              # monotonic; OOM-kill ordering
+    idle_since: float = 0.0              # monotonic; idle-pool LRU eviction
     actor_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
     actor_created: bool = False  # create_actor completed on this worker
+    env_key: str = ""            # runtime-env pool key ("" = default env)
 
 
 @dataclass
@@ -67,6 +70,8 @@ class LeaseRequest:
     pg_id: Optional[str]
     bundle_index: int
     future: asyncio.Future = None
+    runtime_env: Optional[dict] = None
+    env_key: str = ""
 
 
 class Raylet:
@@ -93,7 +98,8 @@ class Raylet:
         self.server = RpcServer(self._make_handler)
         self.gcs_conn: Optional[RpcConnection] = None
         self.workers: Dict[WorkerID, WorkerHandle] = {}
-        self.idle_workers: List[WorkerHandle] = []
+        # env_key ("" = default) -> idle workers with that runtime env.
+        self.idle_workers: Dict[str, List[WorkerHandle]] = {}
         self.pending_leases: List[LeaseRequest] = []
         # pg bundle pools: (pg_id, bundle_index) -> available resources
         self.bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
@@ -107,6 +113,9 @@ class Raylet:
         self._spill_lock = asyncio.Lock()
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
+
+    def _num_idle(self) -> int:
+        return sum(len(v) for v in self.idle_workers.values())
 
     # ------------------------------------------------------------ lifecycle
 
@@ -167,7 +176,7 @@ class Raylet:
                     "raylet: %d leases pending; available=%s busy_workers=%d "
                     "idle=%d total_workers=%d wants=%s",
                     len(self.pending_leases), self.resources_available,
-                    busy, len(self.idle_workers), len(self.workers),
+                    busy, self._num_idle(), len(self.workers),
                     [r.resources for r in self.pending_leases[:4]])
 
     async def _heartbeat_loop(self):
@@ -202,8 +211,9 @@ class Raylet:
             w.worker_id.hex()[:8], w.proc.returncode, w.actor_id,
             w.lease_id)
         self.workers.pop(w.worker_id, None)
-        if w in self.idle_workers:
-            self.idle_workers.remove(w)
+        pool = self.idle_workers.get(w.env_key)
+        if pool and w in pool:
+            pool.remove(w)
         if w.ready is not None and not w.ready.done():
             w.ready.set_exception(RuntimeError(
                 f"worker process exited with code {w.proc.returncode}"))
@@ -280,10 +290,14 @@ class Raylet:
 
     # ------------------------------------------------------------ workers
 
-    def _spawn_worker(self, actor_id: Optional[str] = None) -> WorkerHandle:
+    def _spawn_worker(self, actor_id: Optional[str] = None,
+                      runtime_env: Optional[dict] = None,
+                      env_key: str = "") -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self.worker_env)
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update(runtime_env["env_vars"])
         env.update({
             "RT_WORKER_ID": worker_id.hex(),
             "RT_NODE_ID": self.node_id.hex(),
@@ -291,6 +305,10 @@ class Raylet:
             "RT_GCS_ADDRESS": self.gcs_address,
             "RT_STORE_NAME": self.store_name,
         })
+        if runtime_env:
+            # working_dir/py_modules materialize in the worker after it
+            # connects (it needs the GCS KV to fetch packages).
+            env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env,
@@ -298,18 +316,24 @@ class Raylet:
             stderr=None,
         )
         w = WorkerHandle(worker_id=worker_id, proc=proc, actor_id=actor_id,
+                         env_key=env_key,
                          ready=asyncio.get_running_loop().create_future())
         self.workers[worker_id] = w
         return w
 
-    async def _get_idle_worker(self) -> WorkerHandle:
-        while self.idle_workers:
-            w = self.idle_workers.pop()
+    async def _get_idle_worker(self, runtime_env: Optional[dict] = None,
+                               env_key: str = "") -> WorkerHandle:
+        """Idle workers are reusable only within one runtime env — the
+        reference WorkerPool keys its cache the same way (worker_pool.h
+        runtime_env_hash)."""
+        pool = self.idle_workers.setdefault(env_key, [])
+        while pool:
+            w = pool.pop()
             if w.proc.poll() is None:
                 return w
             await self._on_worker_death(w)
-        w = self._spawn_worker()
-        await asyncio.wait_for(w.ready, timeout=60)
+        w = self._spawn_worker(runtime_env=runtime_env, env_key=env_key)
+        await asyncio.wait_for(w.ready, timeout=120)
         return w
 
     async def _create_actor_worker(self, msg: dict) -> dict:
@@ -325,7 +349,8 @@ class Raylet:
             pool[k] = pool.get(k, 0.0) - v
         w = None
         try:
-            w = self._spawn_worker(actor_id=msg["actor_id"])
+            w = self._spawn_worker(actor_id=msg["actor_id"],
+                                   runtime_env=msg.get("runtime_env"))
             w.actor_resources = (resources, pg_id, msg.get("bundle_index", 0))
             logger.debug("actor %s: spawned worker %s pid=%s, waiting ready",
                          msg["actor_id"][:8], w.worker_id.hex()[:8],
@@ -456,6 +481,8 @@ class Raylet:
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", 0),
             future=asyncio.get_running_loop().create_future(),
+            runtime_env=msg.get("runtime_env"),
+            env_key=msg.get("env_key", ""),
         )
         if not self._fits(req):
             # Hybrid policy (reference hybrid_scheduling_policy.h:24-47):
@@ -483,14 +510,19 @@ class Raylet:
                             f"bundle {req.bundle_index} of pg "
                             f"{req.pg_id[:16]} is not on this node")
                 self.pending_leases.append(req)
+                asyncio.get_running_loop().create_task(
+                    self._dispatch_leases())   # close the await-gap race
                 return await req.future
             if msg.get("no_spill"):
                 # Hard node affinity, or the end of a spillback chain:
                 # run here or wait here.
                 if not self._feasible_ever(req):
-                    raise RuntimeError(
+                    from ray_tpu import exceptions as rex
+                    raise rex.SchedulingError(
                         f"this node can never satisfy {req.resources}")
                 self.pending_leases.append(req)
+                asyncio.get_running_loop().create_task(
+                    self._dispatch_leases())   # close the await-gap race
                 return await req.future
             nodes = await self._get_nodes_cached()
             scored = [
@@ -510,9 +542,15 @@ class Raylet:
                         n, req.resources, by_avail=False)) is not None]
                 if scored:
                     return {"spillback": max(scored)[1]}
-                raise RuntimeError(
-                    f"no node in the cluster can ever satisfy {req.resources}")
+                from ray_tpu import exceptions as rex
+                raise rex.SchedulingError(
+                    f"no node in the cluster can ever satisfy "
+                    f"{req.resources}")
             self.pending_leases.append(req)
+            # Self-wake: resources may have freed during the awaits above
+            # (a return_lease dispatching an empty queue would otherwise
+            # never revisit this request).
+            asyncio.get_running_loop().create_task(self._dispatch_leases())
             return await req.future
         return await self._grant(req)
 
@@ -521,7 +559,8 @@ class Raylet:
         for k, v in req.resources.items():
             pool[k] = pool.get(k, 0.0) - v
         try:
-            w = await self._get_idle_worker()
+            w = await self._get_idle_worker(runtime_env=req.runtime_env,
+                                            env_key=req.env_key)
         except Exception:
             for k, v in req.resources.items():
                 pool[k] = pool.get(k, 0.0) + v
@@ -554,9 +593,23 @@ class Raylet:
                 # (reference: worker_pool.h keeps num_cpus idle workers).
                 idle_cap = max(IDLE_WORKER_CAP_PER_SHAPE,
                                int(2 * self.resources_total.get("CPU", 1)))
-                if msg.get("worker_reusable", True) and \
-                        len(self.idle_workers) < idle_cap:
-                    self.idle_workers.append(w)
+                if msg.get("worker_reusable", True):
+                    w.idle_since = time.monotonic()
+                    self.idle_workers.setdefault(w.env_key, []).append(w)
+                    # Over cap: evict the LRU idle worker across ALL env
+                    # pools — stale runtime-env pools must not pin cap
+                    # slots and force live envs to respawn every lease.
+                    while self._num_idle() > idle_cap:
+                        lru = min(
+                            (x for pool in self.idle_workers.values()
+                             for x in pool),
+                            key=lambda x: x.idle_since)
+                        self.idle_workers[lru.env_key].remove(lru)
+                        lru.proc.terminate()
+                        self.workers.pop(lru.worker_id, None)
+                    for key in [k for k, v in self.idle_workers.items()
+                                if not v]:
+                        del self.idle_workers[key]
                 else:
                     w.proc.terminate()
                     self.workers.pop(w.worker_id, None)
@@ -564,21 +617,32 @@ class Raylet:
         return {"ok": True}
 
     async def _dispatch_leases(self):
-        still_pending = []
-        for req in self.pending_leases:
+        """Grant queued leases that fit now.  A request is REMOVED from the
+        queue before any await: _grant suspends for worker spawn (~1.5s),
+        and a second dispatcher started meanwhile (return_lease /
+        reserve_bundle / heartbeat all trigger one) iterating the same list
+        would double-deduct resources for the same lease and strand a
+        worker (its grant dropped at the future.done() check)."""
+        i = 0
+        while i < len(self.pending_leases):
+            req = self.pending_leases[i]
             if req.future.done():
+                self.pending_leases.pop(i)
                 continue
-            if self._fits(req):
-                try:
-                    grant = await self._grant(req)
-                    if not req.future.done():
-                        req.future.set_result(grant)
-                except Exception as e:
-                    if not req.future.done():
-                        req.future.set_exception(e)
-            else:
-                still_pending.append(req)
-        self.pending_leases = still_pending
+            if not self._fits(req):
+                i += 1
+                continue
+            self.pending_leases.pop(i)   # claim before awaiting
+            try:
+                grant = await self._grant(req)
+            except Exception as e:
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            if not req.future.done():
+                req.future.set_result(grant)
+            # restart: the grant's awaits may have changed the queue
+            i = 0
 
     # -- object spilling (reference raylet/local_object_manager.h:41) --
 
@@ -872,7 +936,7 @@ class Raylet:
         return {
             "node_id": self.node_id.hex(),
             "num_workers": len(self.workers),
-            "num_idle": len(self.idle_workers),
+            "num_idle": self._num_idle(),
             "pending_leases": len(self.pending_leases),
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,

@@ -26,6 +26,7 @@ _ACTOR_DEFAULTS = dict(
     lifetime=None,          # None | "detached"
     max_concurrency=1,
     scheduling_strategy=None,
+    runtime_env=None,
     num_returns=1,
 )
 

@@ -19,6 +19,7 @@ _DEFAULTS = dict(
     max_retries=3,
     retry_exceptions=False,
     scheduling_strategy=None,
+    runtime_env=None,
     name=None,
 )
 
@@ -34,22 +35,28 @@ def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
 
 def _build_scheduling(opts: Dict[str, Any]) -> Dict[str, Any]:
     strategy = opts.get("scheduling_strategy")
-    if strategy is None:
-        return {}
     from ray_tpu.util.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
         PlacementGroupSchedulingStrategy,
     )
+    out: Dict[str, Any] = {}
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
-        return {
+        out = {
             "placement_group_id": strategy.placement_group.id.hex(),
             "bundle_index": strategy.placement_group_bundle_index,
         }
-    if isinstance(strategy, NodeAffinitySchedulingStrategy):
-        return {"node_id": strategy.node_id, "soft": strategy.soft}
-    if isinstance(strategy, str):
-        return {"strategy": strategy}
-    return {}
+    elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+        out = {"node_id": strategy.node_id, "soft": strategy.soft}
+    elif isinstance(strategy, str):
+        out = {"strategy": strategy}
+    renv = opts.get("runtime_env")
+    if renv:
+        from ray_tpu.runtime_env import env_hash, normalize_runtime_env
+        norm = normalize_runtime_env(renv)
+        if norm:
+            out["runtime_env"] = norm
+            out["env_key"] = env_hash(norm)
+    return out
 
 
 class RemoteFunction:
@@ -65,6 +72,12 @@ class RemoteFunction:
 
     def options(self, **kwargs) -> "RemoteFunction":
         return RemoteFunction(self._function, {**self._options, **kwargs})
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this call (reference dag_node build surface:
+        remote_function.py bind -> FunctionNode)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
         core = get_core()

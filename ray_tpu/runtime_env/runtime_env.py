@@ -1,0 +1,164 @@
+"""Per-task/actor runtime environments.
+
+Design analog: reference ``python/ray/runtime_env/`` +
+``_private/runtime_env/`` (working_dir.py, packaging.py — zip + upload to
+GCS, content-addressed ``gcs://_ray_pkg_<hash>.zip`` URIs; the per-node
+agent materializes packages into a local cache).  Supported fields:
+
+- ``env_vars``: {str: str} exported into the worker process environment.
+- ``working_dir``: local directory, zipped and shipped through the GCS KV;
+  workers extract it to a content-addressed cache and chdir into it.
+- ``py_modules``: list of local module directories, shipped the same way
+  and prepended to ``sys.path``.
+
+pip/conda are deliberately absent: this runtime targets hermetic TPU pods
+where the image is the environment (and the build forbids installs); a
+``pip`` key raises rather than silently no-opping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, Optional
+
+PKG_NS = "runtime_env_packages"
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_MAX_PKG_BYTES = 64 * 1024 * 1024
+
+
+class RuntimeEnv(dict):
+    """Dict subclass for parity with the reference's RuntimeEnv class."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[list] = None, **other):
+        super().__init__()
+        if env_vars:
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        self.update(other)
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in
+                       ("__pycache__", ".git", ".venv")]
+            for fn in files:
+                full = os.path.join(root, fn)
+                z.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > _MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PKG_BYTES}); exclude large data files")
+    return data
+
+
+def _upload_dir(path: str) -> str:
+    """Zip + content-addressed upload into the GCS KV; returns pkg uri."""
+    from ray_tpu._private import kv
+    data = _zip_dir(path)
+    digest = hashlib.sha1(data).hexdigest()
+    key = digest.encode()
+    if not kv.kv_exists(key, ns=PKG_NS):
+        kv.kv_put(key, data, ns=PKG_NS, overwrite=False)
+    return f"pkg:{digest}"
+
+
+def normalize_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Validate + materialize local paths into uploaded package URIs.
+    Must run in a connected driver/worker (uploads go through the GCS)."""
+    if not runtime_env:
+        return None
+    unknown = set(runtime_env) - _SUPPORTED
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; supported: "
+            f"{sorted(_SUPPORTED)} (pip/conda are not available on this "
+            f"runtime — bake dependencies into the image)")
+    out: Dict[str, Any] = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in env_vars.items()):
+            raise TypeError("env_vars must be {str: str}")
+        out["env_vars"] = dict(env_vars)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        if wd.startswith("pkg:"):
+            out["working_dir"] = wd
+        else:
+            if not os.path.isdir(wd):
+                raise ValueError(f"working_dir {wd!r} is not a directory")
+            out["working_dir"] = _upload_dir(wd)
+    mods = runtime_env.get("py_modules")
+    if mods:
+        uris = []
+        for m in mods:
+            if isinstance(m, str) and m.startswith("pkg:"):
+                uris.append(m)
+            elif isinstance(m, str) and os.path.isdir(m):
+                uris.append(_upload_dir(m) + "#" + os.path.basename(m))
+            else:
+                raise ValueError(f"py_modules entry {m!r} must be a local "
+                                 f"module directory")
+        out["py_modules"] = uris
+    return out or None
+
+
+def env_hash(normalized: Optional[dict]) -> str:
+    """Worker-pool key: workers are reusable only within one env."""
+    if not normalized:
+        return ""
+    return hashlib.sha1(
+        json.dumps(normalized, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def materialize(normalized: dict, kv_get, cache_root: str) -> dict:
+    """Worker-side: download+extract packages; returns {workdir, paths}.
+    ``kv_get(key_bytes)`` fetches a package from the GCS KV."""
+    os.makedirs(cache_root, exist_ok=True)
+
+    def extract(uri: str) -> str:
+        digest = uri.split(":", 1)[1].split("#", 1)[0]
+        dest = os.path.join(cache_root, digest)
+        done = dest + ".done"
+        if not os.path.exists(done):
+            data = kv_get(digest.encode())
+            if data is None:
+                raise RuntimeError(f"runtime_env package {digest} missing "
+                                   f"from GCS (head restarted?)")
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                z.extractall(dest)
+            open(done, "w").close()
+        return dest
+
+    out = {"workdir": None, "paths": []}
+    if normalized.get("working_dir"):
+        out["workdir"] = extract(normalized["working_dir"])
+        out["paths"].append(out["workdir"])
+    for uri in normalized.get("py_modules", []):
+        base = extract(uri)
+        # "pkg:<sha>#modname": the zip root IS the module dir; expose its
+        # parent so `import modname` works.
+        if "#" in uri:
+            name = uri.split("#", 1)[1]
+            target = os.path.join(base, "_mods", name)
+            if not os.path.isdir(target):
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                import shutil
+                shutil.copytree(base, target,
+                                ignore=shutil.ignore_patterns("_mods"))
+            out["paths"].append(os.path.join(base, "_mods"))
+        else:
+            out["paths"].append(base)
+    return out

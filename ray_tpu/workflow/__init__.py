@@ -1,0 +1,13 @@
+"""Durable workflow execution over DAGs.
+
+Design analog: reference ``python/ray/workflow/`` — ``workflow.run``
+(api.py:120), ``workflow.resume`` (api.py:232): run a task DAG with every
+step's output checkpointed to storage, so a crashed run resumes from the
+last completed step with exactly-once step execution.
+"""
+
+from ray_tpu.workflow.api import (get_output, get_status, init, list_all,
+                                  resume, run, run_async)
+
+__all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
+           "list_all"]

@@ -1,0 +1,182 @@
+"""Workflow engine: DAG steps with durable per-step checkpoints.
+
+Design analog: reference ``python/ray/workflow/api.py`` (run:120,
+resume:232) + ``workflow_storage.py``: each step's output is pickled to
+``<storage>/<workflow_id>/steps/<step_id>.pkl`` before the step is
+considered done; resume loads completed steps instead of re-running them
+(exactly-once per step).  Step ids are deterministic positions in the DAG
+topology so the same DAG resumes against its own checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None):
+    """Set the workflow storage root (reference: workflow.init storage
+    URI; local directories only here)."""
+    global _storage_dir
+    _storage_dir = storage or os.path.join(tempfile.gettempdir(),
+                                           "rt_workflows")
+    os.makedirs(_storage_dir, exist_ok=True)
+    return _storage_dir
+
+
+def _storage() -> str:
+    return _storage_dir or init()
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic step id per node: topo position + step name."""
+    ids = {}
+    for i, node in enumerate(dag.topo_order()):
+        name = node.name if isinstance(node, FunctionNode) \
+            else type(node).__name__
+        ids[id(node)] = f"{i:04d}_{name}"
+    return ids
+
+
+def _meta_path(workflow_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), "meta.json")
+
+
+def _write_meta(workflow_id: str, **updates):
+    path = _meta_path(workflow_id)
+    meta = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            meta = json.load(f)
+    meta.update(updates)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+    return meta
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: tuple = (), _dag_source=None) -> Any:
+    """Execute the DAG durably; blocks and returns the final output."""
+    workflow_id = workflow_id or f"wf_{int(time.time() * 1000):x}"
+    wf = _wf_dir(workflow_id)
+    os.makedirs(os.path.join(wf, "steps"), exist_ok=True)
+    # Persist the DAG itself so `resume(workflow_id)` works from a fresh
+    # process without the user re-supplying it.
+    import cloudpickle
+    dag_path = os.path.join(wf, "dag.pkl")
+    if not os.path.exists(dag_path):
+        with open(dag_path, "wb") as f:
+            cloudpickle.dump((dag, args), f)
+    _write_meta(workflow_id, status="RUNNING", start_time=time.time())
+    try:
+        result = _execute(dag, workflow_id, args)
+        _write_meta(workflow_id, status="SUCCEEDED", end_time=time.time())
+        return result
+    except Exception as e:
+        _write_meta(workflow_id, status="FAILED", error=str(e),
+                    end_time=time.time())
+        raise
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              args: tuple = ()):
+    """Run in a daemon thread; returns (workflow_id, thread)."""
+    import threading
+    workflow_id = workflow_id or f"wf_{int(time.time() * 1000):x}"
+    t = threading.Thread(target=run, args=(dag,),
+                         kwargs={"workflow_id": workflow_id, "args": args},
+                         daemon=True)
+    t.start()
+    return workflow_id, t
+
+
+def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
+    ids = _step_ids(dag)
+    steps_dir = os.path.join(_wf_dir(workflow_id), "steps")
+    resolved: Dict[int, Any] = {}
+
+    def step_path(node):
+        return os.path.join(steps_dir, ids[id(node)] + ".pkl")
+
+    for node in dag.topo_order():
+        if isinstance(node, InputNode):
+            if len(input_args) != 1:
+                raise TypeError("workflow input must be a single value "
+                                "(pass args=(value,))")
+            resolved[id(node)] = input_args[0]
+            continue
+        if isinstance(node, MultiOutputNode):
+            resolved[id(node)] = [node._resolve(a, resolved)
+                                  for a in node._bound_args]
+            continue
+        path = step_path(node)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                resolved[id(node)] = pickle.load(f)
+            continue
+        # Submit with materialized parent values (durable boundary: the
+        # checkpoint, not the object store, is the source of truth).
+        args = [node._resolve(a, resolved) for a in node._bound_args]
+        kwargs = {k: node._resolve(v, resolved)
+                  for k, v in node._bound_kwargs.items()}
+        value = ray_tpu.get(node._fn.remote(*args, **kwargs))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)   # atomic: a step is done iff its file exists
+        resolved[id(node)] = value
+    return resolved[id(dag)]
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a workflow from storage; completed steps load from their
+    checkpoints (reference api.py:232)."""
+    import cloudpickle
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    with open(dag_path, "rb") as f:
+        dag, args = cloudpickle.load(f)
+    return run(dag, workflow_id=workflow_id, args=args)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    path = _meta_path(workflow_id)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f).get("status")
+
+
+def get_output(workflow_id: str) -> Any:
+    """Final output of a SUCCEEDED workflow (from its last step's
+    checkpoint)."""
+    if get_status(workflow_id) != "SUCCEEDED":
+        raise ValueError(f"workflow {workflow_id} has not succeeded")
+    return resume(workflow_id)   # every step cached: pure checkpoint reads
+
+
+def list_all() -> List[Dict[str, Any]]:
+    root = _storage()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _meta_path(wid)
+        if os.path.exists(meta):
+            with open(meta) as f:
+                out.append({"workflow_id": wid, **json.load(f)})
+    return out

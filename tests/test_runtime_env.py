@@ -1,0 +1,80 @@
+"""Per-task/actor runtime environments: env_vars, working_dir, py_modules.
+
+Reference analogs: python/ray/tests/test_runtime_env_env_vars.py and
+test_runtime_env_working_dir*.py (packages shipped via GCS, extracted into
+a per-node cache; workers pooled per runtime env).
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def renv_cluster():
+    ray_tpu.init(num_cpus=4, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_isolated_per_task(renv_cluster):
+    @ray_tpu.remote
+    def read_flag():
+        return os.environ.get("RT_TEST_FLAG")
+
+    with_env = read_flag.options(
+        runtime_env={"env_vars": {"RT_TEST_FLAG": "42"}})
+    assert ray_tpu.get(with_env.remote(), timeout=120) == "42"
+    # A plain task must NOT run in the env-var worker pool.
+    assert ray_tpu.get(read_flag.remote(), timeout=120) is None
+
+
+def test_working_dir_ships_files(renv_cluster, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload-7")
+    (proj / "helper.py").write_text("VALUE = 123\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use_working_dir():
+        import helper  # working_dir joins sys.path
+        with open("data.txt") as f:  # and becomes the cwd
+            return f.read(), helper.VALUE
+
+    data, value = ray_tpu.get(use_working_dir.remote(), timeout=120)
+    assert data == "payload-7" and value == 123
+
+
+def test_py_modules_importable(renv_cluster, tmp_path):
+    mod = tmp_path / "mymod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("def answer():\n    return 21 * 2\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_module():
+        import mymod
+        return mymod.answer()
+
+    assert ray_tpu.get(use_module.remote(), timeout=120) == 42
+
+
+def test_actor_runtime_env(renv_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=120) == "yes"
+
+
+def test_unsupported_keys_rejected(renv_cluster):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="pip"):
+        f.remote()
