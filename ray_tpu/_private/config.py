@@ -1,0 +1,108 @@
+"""Central runtime configuration.
+
+Design analog: reference ``src/ray/common/ray_config.h`` +
+``ray_config_def.h`` (RAY_CONFIG flags, overridable per-process via
+``RAY_<name>`` env vars and the ``_system_config`` dict passed to
+``ray.init``, which is forwarded to every spawned daemon).
+
+Resolution order (low to high): dataclass default < individual
+``RT_<NAME>`` env var < ``RT_SYSTEM_CONFIG`` JSON blob / explicit
+``apply_system_config`` (``ray_tpu.init(_system_config=...)``).  The blob
+outranks per-field env vars so a driver's ``_system_config`` resolves
+identically in the driver and in every daemon/worker it spawns (the blob
+is how the overrides propagate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
+
+SYSTEM_CONFIG_ENV = "RT_SYSTEM_CONFIG"
+
+
+@dataclass
+class RtConfig:
+    # -- object plumbing --
+    inline_max_bytes: int = 100 * 1024      # owner-inline object ceiling
+    transfer_chunk_bytes: int = 4 * 1024 * 1024  # node-to-node pull frames
+    # -- control plane --
+    heartbeat_period_s: float = 0.5
+    health_timeout_s: float = 15.0          # missed-heartbeat death window
+    gcs_snapshot_period_s: float = 1.0
+    node_view_cache_s: float = 0.5          # spill/SPREAD scoring staleness
+    task_event_retention: int = 20000
+    # -- scheduling --
+    max_spillback_hops: int = 8
+    idle_worker_cap_per_shape: int = 8
+    worker_start_timeout_s: float = 120.0
+    lease_request_timeout_s: float = 600.0
+    # -- memory management --
+    spill_high_water: float = 0.8
+    spill_low_water: float = 0.6
+    memory_usage_threshold: float = 0.97
+    memory_monitor_period_s: float = 1.0
+    # -- retries --
+    task_max_retries: int = 3
+    actor_creation_attempts: int = 3
+
+    @classmethod
+    def _from_env(cls) -> "RtConfig":
+        cfg = cls()
+        for f in fields(cls):
+            env = os.environ.get(f"RT_{f.name.upper()}")
+            if env is not None:
+                try:
+                    setattr(cfg, f.name, type(getattr(cfg, f.name))(env))
+                except (TypeError, ValueError):
+                    pass
+        # The blob wins over per-field env vars: it carries the driver's
+        # _system_config, which must resolve the same in every process.
+        blob = os.environ.get(SYSTEM_CONFIG_ENV)
+        if blob:
+            try:
+                cfg._apply(json.loads(blob))
+            except (json.JSONDecodeError, TypeError):
+                pass
+        return cfg
+
+    def _apply(self, overrides: Dict[str, Any]):
+        known = {f.name for f in fields(self)}
+        unknown = set(overrides) - known
+        if unknown:
+            raise ValueError(
+                f"unknown _system_config keys {sorted(unknown)}; known: "
+                f"{sorted(known)}")
+        for k, v in overrides.items():
+            setattr(self, k, type(getattr(self, k))(v))
+
+
+_config: Optional[RtConfig] = None
+
+
+def config() -> RtConfig:
+    global _config
+    if _config is None:
+        _config = RtConfig._from_env()
+    return _config
+
+
+def apply_system_config(overrides: Optional[Dict[str, Any]]):
+    """Apply ``ray_tpu.init(_system_config=...)`` to this process AND
+    export it so spawned daemons/workers inherit the same view (the
+    reference serializes _system_config into the raylet/GCS command
+    lines)."""
+    if not overrides:
+        return
+    config()._apply(overrides)
+    merged = {}
+    blob = os.environ.get(SYSTEM_CONFIG_ENV)
+    if blob:
+        try:
+            merged = json.loads(blob)
+        except json.JSONDecodeError:
+            merged = {}
+    merged.update(overrides)
+    os.environ[SYSTEM_CONFIG_ENV] = json.dumps(merged)

@@ -48,8 +48,16 @@ from ray_tpu._private.serialization import get_context
 
 logger = logging.getLogger(__name__)
 
-INLINE_MAX = 100 * 1024  # objects at or below this ride inline (reference: 100KB)
-DEFAULT_MAX_RETRIES = 3
+from ray_tpu._private.config import config as _rt_config
+
+
+def INLINE_MAX() -> int:
+    # objects at or below this ride inline in the owner (reference: 100KB)
+    return _rt_config().inline_max_bytes
+
+
+def DEFAULT_MAX_RETRIES() -> int:
+    return _rt_config().task_max_retries
 
 
 def _serialize_exception(e: BaseException) -> bytes:
@@ -438,7 +446,7 @@ class CoreWorker:
     async def _put_serialized(self, oid: ObjectID, ser) -> None:
         h = oid.hex()
         self.owned.add(h)
-        if ser.total_size <= INLINE_MAX or self.plasma is None:
+        if ser.total_size <= INLINE_MAX() or self.plasma is None:
             self._store_local(h, "val", ser.to_bytes())
         else:
             await self._plasma_put(oid, ser)
@@ -704,11 +712,11 @@ class CoreWorker:
         if isinstance(value, ObjectRef):
             entry = self.memory_store.get(value.hex())
             if entry is not None and entry[0] == "val" and \
-                    len(entry[1]) <= INLINE_MAX:
+                    len(entry[1]) <= INLINE_MAX():
                 return ("v", entry[1])
             return ("ref", value.hex(), value.owner_address)
         ser = self.ser.serialize(value)
-        if ser.total_size <= INLINE_MAX or self.plasma is None:
+        if ser.total_size <= INLINE_MAX() or self.plasma is None:
             return ("v", ser.to_bytes())
         oid = ObjectID.for_task_return(task_id_generator.next(), 0)
         self._run_on_loop_sync(self._put_serialized(oid, ser))
@@ -741,9 +749,11 @@ class CoreWorker:
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, func, args, kwargs, *, num_returns=1,
-                    resources=None, max_retries=DEFAULT_MAX_RETRIES,
+                    resources=None, max_retries=None,
                     retry_exceptions=False, scheduling=None,
                     name=None) -> List[ObjectRef]:
+        if max_retries is None:
+            max_retries = DEFAULT_MAX_RETRIES()
         fid = self.export_function(func)
         task_id = task_id_generator.next()
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
@@ -821,7 +831,7 @@ class CoreWorker:
         import time as _time
         now = _time.monotonic()
         ts, nodes = getattr(self, "_node_view_cache", (0.0, None))
-        if nodes is None or now - ts > 0.5:
+        if nodes is None or now - ts > _rt_config().node_view_cache_s:
             nodes = await self.gcs.request({"type": "get_nodes"})
             self._node_view_cache = (now, nodes)
         return nodes
@@ -878,21 +888,24 @@ class CoreWorker:
                     if n["node_id"] == target_node:
                         raylet = await self._get_worker_conn(n["address"])
                         break
-        grant = await raylet.request(lease_msg, timeout=600)
+        grant = await raylet.request(
+            lease_msg, timeout=_rt_config().lease_request_timeout_s)
         grant_conn = raylet   # the raylet that actually granted the lease
         visited = []
-        for _ in range(8):
+        max_hops = _rt_config().max_spillback_hops
+        for _ in range(max_hops):
             if "spillback" not in grant:
                 break
             visited.append(grant["spillback"])
             lease_msg["exclude"] = visited
             spill_conn = await self._get_worker_conn(grant["spillback"])
-            if len(visited) == 8:
+            if len(visited) == max_hops:
                 # Hop budget exhausted (stale availability views chasing a
                 # saturated cluster): stop spilling and QUEUE at the final
                 # node — transient saturation must wait, not fail.
                 lease_msg["no_spill"] = True
-            grant = await spill_conn.request(lease_msg, timeout=600)
+            grant = await spill_conn.request(
+                lease_msg, timeout=_rt_config().lease_request_timeout_s)
             grant_conn = spill_conn
         if "spillback" in grant:
             raise RuntimeError("lease spillback loop did not converge")
@@ -999,7 +1012,8 @@ class CoreWorker:
         st = self.actor_state.get(actor_id_hex)
         if st is None:
             st = {"address": None, "conn": None, "seq": 0,
-                  "lock": asyncio.Lock(), "inflight": {}}
+                  "lock": asyncio.Lock(), "inflight": {},
+                  "pending_calls": 0, "kill_on_drain": False}
             self.actor_state[actor_id_hex] = st
         return st
 
@@ -1029,6 +1043,22 @@ class CoreWorker:
     async def _submit_actor_call(self, actor_id_hex, call, return_ids,
                                  _retry: int = 0, pinned_args=None):
         st = self._actor(actor_id_hex)
+        if _retry == 0:
+            st["pending_calls"] += 1
+        try:
+            await self._submit_actor_call_inner(actor_id_hex, st, call,
+                                                return_ids, _retry)
+        finally:
+            if _retry == 0:
+                st["pending_calls"] -= 1
+                if st["kill_on_drain"] and st["pending_calls"] == 0:
+                    st["kill_on_drain"] = False
+                    await self.gcs.notify({"type": "kill_actor",
+                                           "actor_id": actor_id_hex,
+                                           "no_restart": True})
+
+    async def _submit_actor_call_inner(self, actor_id_hex, st, call,
+                                       return_ids, _retry):
         try:
             logger.debug("actor call %s.%s: resolving conn",
                          actor_id_hex[:8], call["method"])
@@ -1089,6 +1119,24 @@ class CoreWorker:
                                     "actor_id": actor_id_hex,
                                     "no_restart": no_restart}))
 
+    def kill_actor_nowait(self, actor_id_hex: str):
+        """Fire-and-forget kill for handle GC: __del__ can run on ANY
+        thread — including the IO loop thread — so it must never block on
+        the loop (a synchronous kill_actor from the loop thread deadlocks
+        the whole runtime).  Calls already submitted still complete: with
+        calls in flight the kill is deferred until they drain (reference:
+        out-of-scope termination waits for pending actor tasks)."""
+        async def _kill_when_drained():
+            st = self._actor(actor_id_hex)
+            if st["pending_calls"] > 0:
+                st["kill_on_drain"] = True
+                return
+            await self.gcs.notify({"type": "kill_actor",
+                                   "actor_id": actor_id_hex,
+                                   "no_restart": True})
+
+        asyncio.run_coroutine_threadsafe(_kill_when_drained(), self.loop)
+
     def get_actor_info(self, actor_id_hex: str):
         return self._run(self.gcs.request({"type": "get_actor_info",
                                            "actor_id": actor_id_hex}))
@@ -1118,7 +1166,7 @@ class CoreWorker:
     def store_return_value(self, oid: ObjectID, ser) -> Tuple[str, str, Any]:
         """Store one task return; returns the reply entry (hex, kind, data)."""
         h = oid.hex()
-        if ser.total_size <= INLINE_MAX or self.plasma is None:
+        if ser.total_size <= INLINE_MAX() or self.plasma is None:
             return (h, "inline", ser.to_bytes())
         self._run_on_loop_sync(self._plasma_put(oid, ser))
         self._run_on_loop_sync(self.gcs.request({

@@ -29,10 +29,18 @@ logger = logging.getLogger(__name__)
 
 import os as _os
 
-HEARTBEAT_PERIOD_S = 0.5
-# Generous by default (reference health_check_timeout_ms=30s): on small/1-core
-# hosts a worker's jax import can starve daemons for seconds at a time.
-HEALTH_TIMEOUT_S = float(_os.environ.get("RT_HEALTH_TIMEOUT_S", "15.0"))
+from ray_tpu._private.config import config as _rt_config
+
+
+def _heartbeat_period() -> float:
+    return _rt_config().heartbeat_period_s
+
+
+def _health_timeout() -> float:
+    # Generous by default (reference health_check_timeout_ms=30s): on
+    # small/1-core hosts a worker's jax import can starve daemons for
+    # seconds at a time.
+    return _rt_config().health_timeout_s
 
 # Actor lifecycle states (reference: gcs_actor_manager.h / rpc::ActorTableData)
 PENDING = "PENDING_CREATION"
@@ -150,7 +158,7 @@ class GcsServer:
         self.object_dir: Dict[str, ObjectDirEntry] = {}
         self.subscribers: Dict[str, List[RpcConnection]] = {}
         from collections import deque
-        self.task_events: "deque" = deque(maxlen=20000)
+        self.task_events: "deque" = deque(maxlen=_rt_config().task_event_retention)
         self.metrics: Dict[tuple, dict] = {}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
@@ -278,7 +286,7 @@ class GcsServer:
 
     async def _snapshot_loop(self):
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(_rt_config().gcs_snapshot_period_s)
             if not self._dirty:
                 continue
             try:
@@ -421,11 +429,11 @@ class GcsServer:
 
     async def _health_loop(self):
         while True:
-            await asyncio.sleep(HEARTBEAT_PERIOD_S)
+            await asyncio.sleep(_heartbeat_period())
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and not node.is_head and \
-                        now - node.last_heartbeat > HEALTH_TIMEOUT_S:
+                        now - node.last_heartbeat > _health_timeout():
                     logger.warning("node %s missed heartbeats; marking dead",
                                    node.node_id)
                     await self._mark_node_dead(node)
@@ -578,7 +586,7 @@ class GcsServer:
                     node.resources_available.get(k, 0.0) + v
             actor.node_id = None
             actor.address = None
-            if actor.creation_attempts < 3:
+            if actor.creation_attempts < _rt_config().actor_creation_attempts:
                 actor.creation_attempts += 1
                 logger.info("actor %s: creation retry %d", actor.actor_id,
                             actor.creation_attempts)

@@ -38,13 +38,10 @@ from ray_tpu._private.protocol import RpcConnection, RpcServer, connect
 
 logger = logging.getLogger(__name__)
 
-TRANSFER_CHUNK = 4 * 1024 * 1024  # 4MB frames for node-to-node object pushes
+from ray_tpu._private.config import config
 
-# Spill thresholds as fractions of store capacity (reference:
-# object_spilling_threshold / RAY_object_store_memory high-water behavior).
-SPILL_HIGH_WATER = float(os.environ.get("RT_SPILL_HIGH_WATER", "0.8"))
-SPILL_LOW_WATER = float(os.environ.get("RT_SPILL_LOW_WATER", "0.6"))
-IDLE_WORKER_CAP_PER_SHAPE = 8
+def TRANSFER_CHUNK():
+    return config().transfer_chunk_bytes
 
 
 @dataclass
@@ -194,7 +191,7 @@ class Raylet:
                 })
             except Exception:
                 pass
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(config().heartbeat_period_s)
 
     async def _reap_loop(self):
         """Detect dead worker processes (reference: WorkerPool +
@@ -333,7 +330,7 @@ class Raylet:
                 return w
             await self._on_worker_death(w)
         w = self._spawn_worker(runtime_env=runtime_env, env_key=env_key)
-        await asyncio.wait_for(w.ready, timeout=120)
+        await asyncio.wait_for(w.ready, timeout=config().worker_start_timeout_s)
         return w
 
     async def _create_actor_worker(self, msg: dict) -> dict:
@@ -450,7 +447,7 @@ class Raylet:
         is ~0.5s stale either way)."""
         now = time.monotonic()
         ts, nodes = getattr(self, "_node_view_cache", (0.0, None))
-        if nodes is None or now - ts > 0.5:
+        if nodes is None or now - ts > config().node_view_cache_s:
             nodes = await self.gcs_conn.request({"type": "get_nodes"})
             self._node_view_cache = (now, nodes)
         return nodes
@@ -591,7 +588,7 @@ class Raylet:
                 # ~1.5s of CPU (jax import) while an idle worker is nearly
                 # free, so tearing down above a tiny fixed cap thrashes
                 # (reference: worker_pool.h keeps num_cpus idle workers).
-                idle_cap = max(IDLE_WORKER_CAP_PER_SHAPE,
+                idle_cap = max(config().idle_worker_cap_per_shape,
                                int(2 * self.resources_total.get("CPU", 1)))
                 if msg.get("worker_reusable", True):
                     w.idle_since = time.monotonic()
@@ -657,10 +654,10 @@ class Raylet:
             await asyncio.sleep(1.0)
             try:
                 st = self.plasma.stats()
-                if st["bytes_used"] > SPILL_HIGH_WATER * st["capacity"]:
+                if st["bytes_used"] > config().spill_high_water * st["capacity"]:
                     await self._spill_objects(
                         int(st["bytes_used"] -
-                            SPILL_LOW_WATER * st["capacity"]))
+                            config().spill_low_water * st["capacity"]))
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -730,7 +727,7 @@ class Raylet:
     async def _h_spill_request(self, conn, msg):
         """A local worker's plasma create failed; make room synchronously."""
         freed = await self._spill_objects(int(msg.get("bytes", 0)) or
-                                          TRANSFER_CHUNK)
+                                          TRANSFER_CHUNK())
         return {"freed": freed}
 
     async def _create_with_spill(self, oid: ObjectID, size: int):
@@ -805,10 +802,10 @@ class Raylet:
         return max(leased, key=lambda w: w.busy_since)
 
     async def _memory_monitor_loop(self):
-        threshold = float(os.environ.get("RT_MEMORY_USAGE_THRESHOLD", "0.97"))
+        threshold = config().memory_usage_threshold
         usage_fn = self._memory_usage_fn or self.system_memory_usage_fraction
         while not self._shutdown:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(config().memory_monitor_period_s)
             try:
                 usage = usage_fn()
                 if usage < threshold:
@@ -841,7 +838,7 @@ class Raylet:
                 offset = msg.get("offset", 0)
                 with open(path, "rb") as f:
                     f.seek(offset)
-                    data = f.read(TRANSFER_CHUNK)
+                    data = f.read(TRANSFER_CHUNK())
                 return {"found": True, "total": total, "offset": offset,
                         "data": data}
             except OSError:
@@ -849,7 +846,7 @@ class Raylet:
         try:
             total = len(view)
             offset = msg.get("offset", 0)
-            end = min(offset + TRANSFER_CHUNK, total)
+            end = min(offset + TRANSFER_CHUNK(), total)
             return {"found": True, "total": total, "offset": offset,
                     "data": bytes(view[offset:end])}
         finally:

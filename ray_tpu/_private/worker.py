@@ -48,9 +48,14 @@ class Worker:
         object_store_memory: Optional[int] = None,
         log_level: str = "WARNING",
         _worker_env: Optional[Dict[str, str]] = None,
+        _system_config: Optional[Dict[str, Any]] = None,
     ):
         if self.connected:
             return self.connection_info()
+        # Config overrides (reference: ray.init(_system_config=...)): apply
+        # to this process and export so daemons/workers inherit the view.
+        from ray_tpu._private.config import apply_system_config
+        apply_system_config(_system_config)
         self.namespace = namespace or "default"
         # Same-machine workers must be able to import the driver's modules
         # (reference: workers inherit the driver's environment on a local

@@ -57,9 +57,14 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id_hex: str, class_name: str = "Actor"):
+    def __init__(self, actor_id_hex: str, class_name: str = "Actor",
+                 _original: bool = False):
         self._actor_id_hex = actor_id_hex
         self._class_name = class_name
+        # Only the handle returned by ActorClass.remote() owns the actor's
+        # lifetime (reference: the original handle's out-of-scope kills a
+        # non-detached actor; deserialized copies never do).
+        self._original = _original
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -71,6 +76,18 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id_hex, self._class_name))
+
+    def __del__(self):
+        if not getattr(self, "_original", False):
+            return
+        try:
+            from ray_tpu._private.worker import global_worker
+            core = global_worker.core_worker
+            if core is not None:
+                # Never block in __del__: GC may run on the IO loop thread.
+                core.kill_actor_nowait(self._actor_id_hex)
+        except Exception:
+            pass  # interpreter teardown / already disconnected
 
     @property
     def _actor_id(self) -> str:
@@ -107,7 +124,11 @@ class ActorClass:
             max_concurrency=opts["max_concurrency"],
             scheduling=_build_scheduling(opts),
         )
-        return ActorHandle(actor_id_hex, self._cls.__name__)
+        # Detached/named actors outlive their handles by design; anonymous
+        # actors die with their original handle.
+        original = opts["lifetime"] != "detached" and not opts["name"]
+        return ActorHandle(actor_id_hex, self._cls.__name__,
+                           _original=original)
 
 
 def exit_actor():
