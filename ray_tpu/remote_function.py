@@ -16,7 +16,7 @@ _DEFAULTS = dict(
     num_cpus=1.0,
     num_tpus=0.0,
     resources=None,
-    max_retries=3,
+    max_retries=None,   # None -> config().task_max_retries
     retry_exceptions=False,
     scheduling_strategy=None,
     runtime_env=None,

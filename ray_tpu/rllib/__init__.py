@@ -5,18 +5,24 @@ rollouts on host-CPU actors, one jitted learner program on the device.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy
 from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                Space, VectorEnv, make_vector_env,
                                register_env)
+from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
 from ray_tpu.rllib.policy import Policy, PPOPolicy, compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.rollout_worker import RolloutWorker
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "CartPoleVectorEnv", "Env",
-    "PendulumVectorEnv", "Policy", "PPO", "PPOConfig", "PPOPolicy",
+    "Algorithm", "AlgorithmConfig", "CartPoleVectorEnv", "DQN",
+    "DQNConfig", "DQNPolicy", "Env", "Impala", "ImpalaConfig",
+    "ImpalaPolicy", "PendulumVectorEnv", "Policy", "PPO", "PPOConfig",
+    "PPOPolicy", "PrioritizedReplayBuffer", "ReplayBuffer",
     "RolloutWorker", "SampleBatch", "Space", "VectorEnv", "WorkerSet",
     "compute_gae", "make_vector_env", "register_env",
 ]

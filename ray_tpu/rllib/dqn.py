@@ -1,0 +1,217 @@
+"""DQN: double Q-learning with a target network and prioritized replay.
+
+Design analog: reference ``rllib/algorithms/dqn/dqn.py`` (training_step:
+sample fragments -> store in replay -> N learner updates -> target sync)
+and ``dqn_torch_policy.py`` (double-DQN loss, per-row TD error feeding
+priority updates).  TPU-first: the Q-update (including the target
+network's forward) is one jitted program; epsilon-greedy lives host-side
+in the rollout workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import Policy, ac_init, head_forward
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import (ACTIONS, DONES, NEXT_OBS, OBS,
+                                        REWARDS, SampleBatch)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self._config.update({
+            "policy": "dqn",
+            "hiddens": (64, 64),
+            "lr": 5e-4,
+            "train_batch_size": 64,
+            "buffer_size": 50_000,
+            "learning_starts": 1000,
+            "prioritized_replay": True,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
+            "target_network_update_freq": 500,   # env steps
+            "num_train_iters": 8,                # updates per training_step
+            "double_q": True,
+            "epsilon_initial": 1.0,
+            "epsilon_final": 0.02,
+            "epsilon_timesteps": 10_000,
+            "rollout_fragment_length": 4,
+            "num_envs_per_worker": 8,
+            "gamma": 0.99,
+        })
+
+
+class DQNPolicy(Policy):
+    """Q-network policy; ``replay_style`` makes workers collect raw
+    transitions instead of GAE fragments."""
+
+    replay_style = True
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        if action_space.kind != "discrete":
+            raise ValueError("DQN requires a discrete action space")
+        self.config = config
+        self.num_actions = action_space.n
+        self._rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(seed)
+        self.params = ac_init(key, obs_dim, self.num_actions,
+                              tuple(config.get("hiddens", (64, 64))),
+                              value_head=False)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        import optax
+        self._tx = optax.adam(config.get("lr", 5e-4))
+        self.opt_state = self._tx.init(self.params)
+        self._steps_seen = 0
+
+        gamma = config.get("gamma", 0.99)
+        double_q = config.get("double_q", True)
+
+        @jax.jit
+        def _q(params, obs):
+            return head_forward(params, obs)
+        self._q = _q
+
+        @jax.jit
+        def _update(params, target_params, opt_state, batch, weights):
+            def loss_fn(p):
+                q = head_forward(p, batch[OBS])
+                q_sel = jnp.take_along_axis(
+                    q, batch[ACTIONS][:, None].astype(jnp.int32), 1)[:, 0]
+                q_next_t = head_forward(target_params, batch[NEXT_OBS])
+                if double_q:
+                    q_next_o = head_forward(p, batch[NEXT_OBS])
+                    best = jnp.argmax(q_next_o, axis=1)
+                else:
+                    best = jnp.argmax(q_next_t, axis=1)
+                q_next = jnp.take_along_axis(q_next_t, best[:, None], 1)[:, 0]
+                target = batch[REWARDS] + gamma * (
+                    1.0 - batch[DONES].astype(jnp.float32)
+                ) * jax.lax.stop_gradient(q_next)
+                td = q_sel - target
+                # Huber on weighted TD errors (priority-corrected).
+                loss = jnp.mean(weights * jnp.where(
+                    jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5))
+                return loss, td
+
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            import optax as _ox
+            params = _ox.apply_updates(params, updates)
+            return params, opt_state, loss, jnp.abs(td)
+        self._update = _update
+
+    # -- rollout side -----------------------------------------------------
+
+    def _epsilon_at(self, global_steps: int) -> float:
+        c = self.config
+        frac = min(1.0, global_steps /
+                   max(1, c.get("epsilon_timesteps", 10_000)))
+        return c.get("epsilon_initial", 1.0) + frac * (
+            c.get("epsilon_final", 0.02) - c.get("epsilon_initial", 1.0))
+
+    def _epsilon(self) -> float:
+        # epsilon_timesteps is a GLOBAL env-step budget: with N samplers
+        # each seeing 1/N of the steps, scale local steps back up so the
+        # schedule anneals at the configured global rate.
+        samplers = max(1, self.config.get("num_rollout_workers", 0))
+        return self._epsilon_at(self._steps_seen * samplers)
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))
+        greedy = q.argmax(axis=1)
+        eps = self._epsilon()
+        self._steps_seen += len(obs)
+        explore = self._rng.random(len(obs)) < eps
+        random_a = self._rng.integers(0, self.num_actions, len(obs))
+        return {ACTIONS: np.where(explore, random_a, greedy)}
+
+    # -- learner side -----------------------------------------------------
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        weights = jnp.asarray(
+            np.asarray(batch.get("weights",
+                                 np.ones(batch.count)), np.float32))
+        device_batch = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            NEXT_OBS: jnp.asarray(np.asarray(batch[NEXT_OBS], np.float32)),
+            ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS])),
+            REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+            DONES: jnp.asarray(np.asarray(batch[DONES])),
+        }
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, device_batch,
+            weights)
+        return {"loss": float(loss), "td_errors": np.asarray(td),
+                "mean_q_td": float(td.mean())}
+
+    def update_target(self):
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class DQN(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "dqn")
+        super().setup(config)
+        if config.get("prioritized_replay", True):
+            self.replay = PrioritizedReplayBuffer(
+                config.get("buffer_size", 50_000),
+                alpha=config.get("prioritized_replay_alpha", 0.6),
+                seed=config.get("seed", 0))
+        else:
+            self.replay = ReplayBuffer(config.get("buffer_size", 50_000),
+                                       seed=config.get("seed", 0))
+        self._since_target_sync = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        batch = self.workers.synchronous_sample()
+        self._timesteps_total += batch.count
+        self._since_target_sync += batch.count
+        self.replay.add(batch)
+
+        stats: Dict[str, Any] = {}
+        policy = self.workers.local_worker.policy
+        if len(self.replay) >= c.get("learning_starts", 1000):
+            for _ in range(c.get("num_train_iters", 8)):
+                if isinstance(self.replay, PrioritizedReplayBuffer):
+                    train = self.replay.sample(
+                        c.get("train_batch_size", 64),
+                        beta=c.get("prioritized_replay_beta", 0.4))
+                else:
+                    train = self.replay.sample(
+                        c.get("train_batch_size", 64))
+                stats = policy.learn_on_batch(train)
+                if isinstance(self.replay, PrioritizedReplayBuffer):
+                    self.replay.update_priorities(
+                        train["batch_indexes"], stats.pop("td_errors"))
+                else:
+                    stats.pop("td_errors", None)
+            if self._since_target_sync >= c.get(
+                    "target_network_update_freq", 500):
+                policy.update_target()
+                self._since_target_sync = 0
+            self.workers.sync_weights()
+        return {"info": {"learner": {k: v for k, v in stats.items()
+                                     if np.isscalar(v)}},
+                "buffer_size": len(self.replay),
+                # Report from GLOBAL timesteps: the local policy never
+                # samples when remote workers exist, so its own counter
+                # would misreport a frozen epsilon_initial.
+                "epsilon": policy._epsilon_at(self._timesteps_total)}

@@ -1,0 +1,259 @@
+"""IMPALA: asynchronous actors + V-trace off-policy correction.
+
+Design analog: reference ``rllib/algorithms/impala/impala.py:533``
+(training_step drains completed sample futures and immediately re-issues
+them — actors never block on the learner) with the learner-side prefetch
+pipeline of ``execution/multi_gpu_learner_thread.py:20`` /
+``_MultiGPULoaderThread:187``: a host loader thread converts the next
+batch to device arrays while the current update runs, double-buffering
+host->TPU transfers.
+
+TPU-first: the whole V-trace computation + policy update is ONE jitted
+program (lax.scan over reversed time); actors are host-CPU processes whose
+stale-policy drift is exactly what V-trace's rho/c clipping corrects.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import (Categorical, Policy, ac_forward, ac_init)
+from ray_tpu.rllib.sample_batch import (ACTIONS, ACTION_LOGP, DONES, OBS,
+                                        REWARDS, SampleBatch)
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(Impala)
+        self._config.update({
+            "policy": "impala",
+            "hiddens": (64, 64),
+            "lr": 6e-4,
+            "gamma": 0.99,
+            "vtrace_rho_clip": 1.0,
+            "vtrace_c_clip": 1.0,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "grad_clip": 40.0,
+            "broadcast_interval": 1,      # weight sync every N updates
+            "num_batches_per_step": 4,    # learner updates per training_step
+            "rollout_fragment_length": 64,
+            "num_envs_per_worker": 8,
+            "num_rollout_workers": 2,
+        })
+
+
+def vtrace(behavior_logp, target_logp, rewards, dones, values, bootstrap,
+           gamma, rho_clip=1.0, c_clip=1.0):
+    """V-trace targets (Espeholt et al. 2018), batch-major [B, T] inputs.
+    Returns (vs targets [B, T], pg advantages [B, T])."""
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_clip)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_clip)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    # values_{t+1} with bootstrap at the end, zeroed across terminations.
+    values_next = jnp.concatenate(
+        [values[:, 1:], bootstrap[:, None]], axis=1) * not_done
+    deltas = rho * (rewards + gamma * values_next - values)
+
+    def body(acc, xs):
+        delta_t, c_t, nd_t = xs
+        acc = delta_t + gamma * nd_t * c_t * acc
+        return acc, acc
+
+    # scan over reversed time (time axis -> leading for scan)
+    xs = (jnp.swapaxes(deltas, 0, 1)[::-1],
+          jnp.swapaxes(c, 0, 1)[::-1],
+          jnp.swapaxes(not_done, 0, 1)[::-1])
+    _, acc = jax.lax.scan(body, jnp.zeros_like(deltas[:, 0]), xs)
+    vs_minus_v = jnp.swapaxes(acc[::-1], 0, 1)
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[:, 1:], bootstrap[:, None]],
+                              axis=1) * not_done
+    pg_adv = rho * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaPolicy(Policy):
+    """Actor-critic policy with a jitted V-trace update."""
+
+    sequence_style = True
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        if action_space.kind != "discrete":
+            raise ValueError("this IMPALA implementation is discrete-only")
+        self.config = config
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = ac_init(init_rng, obs_dim, action_space.n,
+                              tuple(config.get("hiddens", (64, 64))))
+        import optax
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 40.0)),
+            optax.adam(config.get("lr", 6e-4)))
+        self.opt_state = self._tx.init(self.params)
+
+        @jax.jit
+        def _act(params, rng, obs):
+            pi, v = ac_forward(params, obs)
+            actions = Categorical.sample(rng, pi)
+            return actions, Categorical.logp(pi, actions)
+        self._act = _act
+
+        gamma = config.get("gamma", 0.99)
+        rho_clip = config.get("vtrace_rho_clip", 1.0)
+        c_clip = config.get("vtrace_c_clip", 1.0)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            B, T = batch[REWARDS].shape
+            flat_obs = batch[OBS].reshape((B * T,) + batch[OBS].shape[2:])
+
+            def loss_fn(p):
+                pi, v = ac_forward(p, flat_obs)
+                logp = Categorical.logp(
+                    pi, batch[ACTIONS].reshape((B * T,)))
+                entropy = Categorical.entropy(pi)
+                v = v.reshape((B, T))
+                logp_bt = logp.reshape((B, T))
+                _, boot_v = ac_forward(p, batch["bootstrap_obs"])
+                vs, pg_adv = vtrace(
+                    batch[ACTION_LOGP], logp_bt, batch[REWARDS],
+                    batch[DONES], v, boot_v, gamma, rho_clip, c_clip)
+                pg_loss = -jnp.mean(logp_bt * pg_adv)
+                vf_loss = 0.5 * jnp.mean((vs - v) ** 2)
+                ent = jnp.mean(entropy)
+                total = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+                return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                               "entropy": ent, "total_loss": total}
+
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            import optax as _ox
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = _ox.apply_updates(params, updates)
+            return params, opt_state, stats
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._rng, rng = jax.random.split(self._rng)
+        a, logp = self._act(self.params, rng, jnp.asarray(obs, jnp.float32))
+        return {ACTIONS: np.asarray(a), ACTION_LOGP: np.asarray(logp)}
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        """batch is already device-resident (the loader thread put it)."""
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+def _to_device(batch: SampleBatch) -> Dict[str, jnp.ndarray]:
+    return {
+        OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+        ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS])),
+        ACTION_LOGP: jnp.asarray(np.asarray(batch[ACTION_LOGP],
+                                            np.float32)),
+        REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+        DONES: jnp.asarray(np.asarray(batch[DONES])),
+        "bootstrap_obs": jnp.asarray(np.asarray(batch["bootstrap_obs"],
+                                                np.float32)),
+    }
+
+
+class _LoaderThread(threading.Thread):
+    """Host->device prefetch: converts the next host batch to device
+    arrays while the learner updates on the current one (reference
+    _MultiGPULoaderThread:187)."""
+
+    def __init__(self, in_q: "queue.Queue", out_q: "queue.Queue"):
+        super().__init__(daemon=True, name="impala-loader")
+        self.in_q = in_q
+        self.out_q = out_q
+
+    def run(self):
+        while True:
+            item = self.in_q.get()
+            if item is None:
+                self.out_q.put(None)
+                return
+            self.out_q.put(_to_device(item))
+
+
+class Impala(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "impala")
+        super().setup(config)
+        self._host_q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._device_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._loader = _LoaderThread(self._host_q, self._device_q)
+        self._loader.start()
+        self._inflight: Dict[str, Any] = {}   # ref hex -> (ref, worker)
+        self._updates = 0
+        self.workers.ready()
+        self._kick_all()
+
+    def _kick_all(self):
+        for w in self.workers.remote_workers:
+            ref = w.sample.remote()
+            self._inflight[ref.hex()] = (ref, w)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        c = self.config
+        stats: Dict[str, float] = {}
+        n_batches = 0
+        policy = self.workers.local_worker.policy
+        target = c.get("num_batches_per_step", 4)
+        while n_batches < target:
+            if self._inflight:
+                refs = [r for r, _ in self._inflight.values()]
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=120)
+                if not done:
+                    # Nothing completed within the poll window (slow jit
+                    # compile / starved host): re-poll rather than blocking
+                    # on an empty device queue forever.
+                    continue
+                for ref in done:
+                    _, worker = self._inflight.pop(ref.hex())
+                    batch = ray_tpu.get(ref)
+                    b, t = batch[REWARDS].shape
+                    self._timesteps_total += b * t
+                    self._host_q.put(batch)
+                    # Re-issue IMMEDIATELY: the actor never idles waiting
+                    # for the learner (the async heart of IMPALA).
+                    nref = worker.sample.remote()
+                    self._inflight[nref.hex()] = (nref, worker)
+            else:  # no remote workers: sample locally
+                self._host_q.put(self.workers.local_worker.sample())
+            device_batch = self._device_q.get()
+            stats = policy.learn_on_batch(device_batch)
+            n_batches += 1
+            self._updates += 1
+            if self._updates % c.get("broadcast_interval", 1) == 0:
+                self.workers.sync_weights()
+        return {"info": {"learner": stats}, "num_updates": self._updates,
+                **{f"learner_{k}": v for k, v in stats.items()}}
+
+    def cleanup(self) -> None:
+        try:
+            self._host_q.put(None)
+        except Exception:
+            pass
+        super().cleanup()

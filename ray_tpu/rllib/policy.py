@@ -36,7 +36,8 @@ def _orthogonal(rng, shape, scale):
 
 
 def ac_init(rng: jax.Array, obs_dim: int, num_outputs: int,
-            hiddens=(64, 64)) -> Dict:
+            hiddens=(64, 64), value_head: bool = True,
+            head_scale: float = 0.01) -> Dict:
     keys = jax.random.split(rng, len(hiddens) + 2)
     params, sizes = {}, (obs_dim,) + tuple(hiddens)
     for i in range(len(hiddens)):
@@ -45,11 +46,23 @@ def ac_init(rng: jax.Array, obs_dim: int, num_outputs: int,
                              jnp.sqrt(2.0)),
             "b": jnp.zeros((sizes[i + 1],))}
     params["pi"] = {"w": _orthogonal(keys[-2], (sizes[-1], num_outputs),
-                                     0.01),
+                                     head_scale),
                     "b": jnp.zeros((num_outputs,))}
-    params["vf"] = {"w": _orthogonal(keys[-1], (sizes[-1], 1), 1.0),
-                    "b": jnp.zeros((1,))}
+    if value_head:
+        params["vf"] = {"w": _orthogonal(keys[-1], (sizes[-1], 1), 1.0),
+                        "b": jnp.zeros((1,))}
     return params
+
+
+def head_forward(params: Dict, obs: jax.Array) -> jax.Array:
+    """Trunk + pi head only (Q-values for DQN-style policies)."""
+    x = obs
+    i = 0
+    while f"trunk{i}" in params:
+        p = params[f"trunk{i}"]
+        x = jnp.tanh(x @ p["w"] + p["b"])
+        i += 1
+    return x @ params["pi"]["w"] + params["pi"]["b"]
 
 
 def ac_forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -17,8 +17,23 @@ import numpy as np
 from ray_tpu.rllib.env import make_vector_env
 from ray_tpu.rllib.policy import PPOPolicy, compute_gae
 from ray_tpu.rllib.sample_batch import (
-    ACTIONS, ACTION_LOGP, ADVANTAGES, DONES, OBS, REWARDS, SampleBatch,
-    VALUE_TARGETS, VF_PREDS)
+    ACTIONS, ACTION_LOGP, ADVANTAGES, DONES, NEXT_OBS, OBS, REWARDS,
+    SampleBatch, VALUE_TARGETS, VF_PREDS)
+
+
+def _resolve_policy_class(name: str):
+    """Policy registry keyed by config['policy'] — resolved lazily so
+    remote workers (fresh processes) don't need the algo module imported
+    up front (reference: ModelCatalog/policy mapping by name)."""
+    if name == "ppo":
+        return PPOPolicy
+    if name == "dqn":
+        from ray_tpu.rllib.dqn import DQNPolicy
+        return DQNPolicy
+    if name == "impala":
+        from ray_tpu.rllib.impala import ImpalaPolicy
+        return ImpalaPolicy
+    raise ValueError(f"unknown policy {name!r}")
 
 
 class RolloutWorker:
@@ -33,8 +48,8 @@ class RolloutWorker:
             config["env"], config.get("num_envs_per_worker", 1), seed=seed,
             **config.get("env_config", {}))
         obs_dim = int(np.prod(self.env.observation_space.shape))
-        self.policy = PPOPolicy(obs_dim, self.env.action_space, config,
-                                seed=seed)
+        self.policy = _resolve_policy_class(config.get("policy", "ppo"))(
+            obs_dim, self.env.action_space, config, seed=seed)
         self._obs = self.env.vector_reset(seed=seed)
         n = self.env.num_envs
         self._episode_rewards = np.zeros((n,), np.float64)
@@ -42,8 +57,107 @@ class RolloutWorker:
         self._completed_rewards: List[float] = []
         self._completed_lens: List[int] = []
 
+    def _record_step_metrics(self, reward: np.ndarray, done: np.ndarray):
+        """Per-step episode bookkeeping shared by all sampling modes."""
+        self._episode_rewards += reward
+        self._episode_lens += 1
+        if done.any():
+            idx = np.nonzero(done)[0]
+            self._completed_rewards.extend(
+                self._episode_rewards[idx].tolist())
+            self._completed_lens.extend(self._episode_lens[idx].tolist())
+            self._episode_rewards[idx] = 0.0
+            self._episode_lens[idx] = 0
+
     # -- sampling ---------------------------------------------------------
     def sample(self) -> SampleBatch:
+        if getattr(self.policy, "replay_style", False):
+            return self._sample_transitions()
+        if getattr(self.policy, "sequence_style", False):
+            return self._sample_sequences()
+        return self._sample_onpolicy()
+
+    def _sample_sequences(self) -> SampleBatch:
+        """Batch-major [n, T, ...] trajectory fragments with behavior logp
+        and a bootstrap obs — the learner applies its own off-policy
+        correction (V-trace for IMPALA; no worker-side GAE)."""
+        T = self.config.get("rollout_fragment_length", 128)
+        n = self.env.num_envs
+        obs_buf = np.empty((T, n) + self._obs.shape[1:], np.float32)
+        act_buf = None
+        logp_buf = np.empty((T, n), np.float32)
+        rew_buf = np.empty((T, n), np.float32)
+        done_buf = np.empty((T, n), bool)
+        for t in range(T):
+            out = self.policy.compute_actions(self._obs)
+            actions = out[ACTIONS]
+            if act_buf is None:
+                act_buf = np.empty((T,) + actions.shape, actions.dtype)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = out[ACTION_LOGP]
+            next_obs, reward, done, info = self.env.vector_step(actions)
+            rew_buf[t] = reward
+            # Truncations count as done for V-trace: the post-reset obs at
+            # t+1 belongs to a NEW episode, so bootstrapping through it
+            # would leak value across the boundary (standard IMPALA treats
+            # every episode end as terminal; the small bias at time-limit
+            # cuts beats cross-episode leakage).
+            done_buf[t] = done
+            self._record_step_metrics(reward, done)
+            self._obs = next_obs
+
+        def bt(a):  # time-major -> batch-major
+            return np.swapaxes(a, 0, 1)
+        return SampleBatch({
+            OBS: bt(obs_buf), ACTIONS: bt(act_buf),
+            ACTION_LOGP: bt(logp_buf), REWARDS: bt(rew_buf),
+            DONES: bt(done_buf),
+            "bootstrap_obs": self._obs.astype(np.float32)})
+
+    def _sample_transitions(self) -> SampleBatch:
+        """Raw (s, a, r, s', done) fragments for replay-based algorithms
+        (DQN family); no GAE postprocessing."""
+        T = self.config.get("rollout_fragment_length", 128)
+        n = self.env.num_envs
+        obs_buf = np.empty((T, n) + self._obs.shape[1:], np.float32)
+        next_buf = np.empty_like(obs_buf)
+        act_buf = None
+        rew_buf = np.empty((T, n), np.float32)
+        done_buf = np.empty((T, n), bool)
+        for t in range(T):
+            out = self.policy.compute_actions(self._obs)
+            actions = out[ACTIONS]
+            if act_buf is None:
+                act_buf = np.empty((T,) + actions.shape, actions.dtype)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            next_obs, reward, done, info = self.env.vector_step(actions)
+            # Terminal next-obs: envs auto-reset, so `next_obs` is the new
+            # episode's first obs; the true terminal obs rides in info.
+            step_next = next_obs
+            term = info.get("terminal_obs")
+            if term is not None and done.any():
+                mask = done.reshape((n,) + (1,) * (next_obs.ndim - 1))
+                step_next = np.where(mask, term, next_obs)
+            next_buf[t] = step_next
+            # Truncations bootstrap: treat truncated as NOT done for the
+            # Bellman target (value continues past the horizon).
+            truncated = info.get("truncated")
+            eff_done = done if truncated is None else (done & ~truncated)
+            rew_buf[t] = reward
+            done_buf[t] = eff_done
+            self._record_step_metrics(reward, done)
+            self._obs = next_obs
+
+        def flat(a):
+            return a.reshape((T * n,) + a.shape[2:])
+        return SampleBatch({
+            OBS: flat(obs_buf), ACTIONS: flat(act_buf),
+            REWARDS: flat(rew_buf), DONES: flat(done_buf),
+            NEXT_OBS: flat(next_buf)})
+
+    def _sample_onpolicy(self) -> SampleBatch:
         T = self.config.get("rollout_fragment_length", 128)
         n = self.env.num_envs
         gamma = self.config.get("gamma", 0.99)
@@ -77,15 +191,7 @@ class RolloutWorker:
             if truncated is not None and truncated.any():
                 term_v = self.policy.compute_values(info["terminal_obs"])
                 trunc_bootstrap[t] = np.where(truncated, term_v, 0.0)
-            self._episode_rewards += reward
-            self._episode_lens += 1
-            if done.any():
-                idx = np.nonzero(done)[0]
-                self._completed_rewards.extend(
-                    self._episode_rewards[idx].tolist())
-                self._completed_lens.extend(self._episode_lens[idx].tolist())
-                self._episode_rewards[idx] = 0.0
-                self._episode_lens[idx] = 0
+            self._record_step_metrics(reward, done)
             self._obs = next_obs
 
         rew_buf = rew_buf + gamma * trunc_bootstrap

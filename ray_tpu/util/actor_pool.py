@@ -20,7 +20,6 @@ class ActorPool:
         self._index_to_future = {}
         self._next_task_index = 0       # submission order
         self._next_return_index = 0     # ordered-get cursor
-        self._pending = []              # (ref, submission index)
 
     # ---------------------------------------------------------- submission
 
@@ -59,12 +58,23 @@ class ActorPool:
 
     def get_next(self, timeout=None) -> Any:
         """Next result in submission order.  A timeout leaves the pool
-        state untouched so the call can be retried."""
+        state untouched so the call can be retried; a task FAILURE advances
+        the cursor (re-raising the error) so iteration continues past it —
+        otherwise a single failed task wedges the ordered stream forever."""
+        from ray_tpu import exceptions as rex
         idx = self._next_return_index
         if idx not in self._index_to_future:
             raise StopIteration("no pending results")
         ref = self._index_to_future[idx]
-        value = ray_tpu.get(ref, timeout=timeout)   # may raise; state kept
+        try:
+            value = ray_tpu.get(ref, timeout=timeout)
+        except rex.GetTimeoutError:
+            raise                          # retryable; state kept
+        except Exception:
+            del self._index_to_future[idx]
+            self._next_return_index += 1
+            self._free_actor(ref)
+            raise
         del self._index_to_future[idx]
         self._next_return_index += 1
         self._free_actor(ref)
