@@ -72,13 +72,17 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 
 
 def wait(refs: List[ObjectRef], *, num_returns: int = 1,
-         timeout: Optional[float] = None, fetch_local: bool = True):
+         timeout: Optional[float] = None, fetch_local: bool = False):
+    """Metadata-only readiness (no value bytes move); fetch_local=True
+    additionally starts pulling ready remote objects to this node in the
+    background (reference: ray.wait fetch_local semantics)."""
     from ray_tpu._private.worker import get_core
     if not isinstance(refs, list):
         raise TypeError("wait() expects a list of ObjectRefs")
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds the number of refs")
-    return get_core().wait(refs, num_returns, timeout)
+    return get_core().wait(refs, num_returns, timeout,
+                           fetch_local=fetch_local)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):

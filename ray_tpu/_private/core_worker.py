@@ -606,8 +606,28 @@ class CoreWorker:
             return False
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
-             timeout: Optional[float] = None):
-        return self._run(self._wait_async(refs, num_returns, timeout))
+             timeout: Optional[float] = None, fetch_local: bool = False):
+        ready, not_ready = self._run(
+            self._wait_async(refs, num_returns, timeout))
+        if fetch_local:
+            # Reference wait(fetch_local=True): start pulling ready remote
+            # objects to this node in the background, without blocking the
+            # wait return (readiness itself stays metadata-only).
+            def _log_pull_error(fut):
+                if fut.exception() is not None:
+                    logger.warning("fetch_local prefetch failed: %s",
+                                   fut.exception())
+
+            for r in ready:
+                h = r.id.hex()
+                entry = self.memory_store.get(h)
+                if (entry is None or entry[0] == "plasma") and \
+                        self.plasma is not None and \
+                        not self.plasma.contains(r.id):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self._pull_to_local(h), self.loop)
+                    fut.add_done_callback(_log_pull_error)
+        return ready, not_ready
 
     async def _probe_ready(self, oid: ObjectID, owner: str):
         """Readiness check that never moves value bytes (reference: wait is
@@ -1163,13 +1183,20 @@ class CoreWorker:
 
     # -- executor-side helpers (used by worker_main's TaskExecutor) --
 
-    def store_return_value(self, oid: ObjectID, ser) -> Tuple[str, str, Any]:
-        """Store one task return; returns the reply entry (hex, kind, data)."""
+    async def store_return_value_async(self, oid: ObjectID, ser
+                                       ) -> Tuple[str, str, Any]:
+        """Store one task return; returns the reply entry (hex, kind, data).
+
+        The GCS location registration is AWAITED before the entry (and thus
+        the task reply) is released: a fire-and-forget add lets the owner
+        observe readiness before the directory knows the location, so an
+        immediate raylet pull (wait fetch_local, remote gets) finds 'no
+        locations' for an object that exists."""
         h = oid.hex()
         if ser.total_size <= INLINE_MAX() or self.plasma is None:
             return (h, "inline", ser.to_bytes())
-        self._run_on_loop_sync(self._plasma_put(oid, ser))
-        self._run_on_loop_sync(self.gcs.request({
+        await self._plasma_put(oid, ser)
+        await self.gcs.request({
             "type": "object_location_add", "object_id": h,
-            "node_id": self.node_id_hex, "owner": ""}))
+            "node_id": self.node_id_hex, "owner": ""})
         return (h, "plasma", None)

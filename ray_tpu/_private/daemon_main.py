@@ -30,10 +30,24 @@ logger = logging.getLogger(__name__)
 async def amain(args) -> None:
     node_id = NodeID.from_random()
     gcs = None
+    dashboard = None
+    dashboard_address = None
     if args.head:
         gcs = GcsServer(persist_path=args.gcs_persist_path)
         gcs_port = await gcs.start(args.gcs_port)
         gcs_address = f"127.0.0.1:{gcs_port}"
+        if args.dashboard_port >= 0:
+            # Best-effort: a taken port (another cluster's dashboard on
+            # 8265) must not abort head startup over observability.
+            try:
+                from ray_tpu.dashboard import DashboardHttpServer
+                dashboard = DashboardHttpServer(gcs)
+                dport = await dashboard.start(args.dashboard_port)
+                dashboard_address = f"127.0.0.1:{dport}"
+            except OSError as e:
+                logger.warning("dashboard disabled: port %s unavailable "
+                               "(%s)", args.dashboard_port, e)
+                dashboard = None
     else:
         gcs_address = args.gcs_address
 
@@ -73,6 +87,7 @@ async def amain(args) -> None:
         "gcs_address": gcs_address,
         "raylet_address": f"127.0.0.1:{raylet_port}",
         "store_name": raylet.store_name,
+        "dashboard_address": dashboard_address,
         "pid": os.getpid(),
     }
     tmp = args.ready_file + ".tmp"
@@ -102,6 +117,8 @@ async def amain(args) -> None:
         asyncio.get_running_loop().create_task(watch_parent())
     await stop.wait()
     await raylet.close()
+    if dashboard is not None:
+        await dashboard.close()
     if gcs is not None:
         await gcs.close()
 
@@ -128,6 +145,9 @@ def main():
     parser.add_argument("--ready-file", required=True)
     parser.add_argument("--worker-env", default=None)
     parser.add_argument("--no-tpu-detect", action="store_true")
+    parser.add_argument("--dashboard-port", type=int, default=0,
+                        help="Head-node HTTP dashboard port (0 = ephemeral, "
+                             "-1 = disabled)")
     parser.add_argument("--gcs-persist-path", default=None,
                         help="JSON snapshot file for GCS fault tolerance "
                              "(head only; reference: Redis-backed "

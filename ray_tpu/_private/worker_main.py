@@ -76,7 +76,7 @@ class TaskExecutor:
             # releases the submitter's arg pins.
             await self.core.flush_borrow_acks()
             logger.debug("exec task %s: done", spec["task_id"][:8])
-            return self._pack_returns(spec, result)
+            return await self._pack_returns(spec, result)
         except SystemExit as e:
             status = "FAILED"
             # Ship buffered task events before dying — the periodic flusher
@@ -96,7 +96,7 @@ class TaskExecutor:
                 "kind": "task", "start": t0, "end": time.time(),
                 "status": status})
 
-    def _pack_returns(self, spec: dict, result) -> dict:
+    async def _pack_returns(self, spec: dict, result) -> dict:
         num_returns = spec["num_returns"]
         if num_returns == 1:
             results = [result]
@@ -112,7 +112,8 @@ class TaskExecutor:
         for i, value in enumerate(results):
             oid = ObjectID.for_task_return(task_id, i)
             ser = self.core.ser.serialize(value)
-            returns.append(self.core.store_return_value(oid, ser))
+            returns.append(
+                await self.core.store_return_value_async(oid, ser))
         return {"ok": True, "returns": returns}
 
     # -- actors --
@@ -172,7 +173,7 @@ class TaskExecutor:
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],
                     "call_id": msg["call_id"]}
             await self.core.flush_borrow_acks()
-            return self._pack_returns(spec, result)
+            return await self._pack_returns(spec, result)
         except SystemExit:
             # exit_actor(): report intended death, reply an error to this call
             # (matching the reference: the exiting call resolves to an

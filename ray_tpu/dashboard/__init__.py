@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.http_server import DashboardHttpServer
+
+__all__ = ["DashboardHttpServer"]

@@ -1,0 +1,175 @@
+"""Dashboard: HTTP/JSON observability endpoint on the head node.
+
+Design analog: reference ``dashboard/`` (DashboardHead head.py:70 + REST
+modules + StateAggregator).  Scope here is the REST surface the state CLI
+and external monitors consume — no React client; the JSON endpoints mirror
+``ray list ...``/``ray summary`` and Prometheus-style metrics.  Implemented
+as a dependency-free asyncio HTTP/1.1 GET server co-hosted with the GCS
+(direct in-process table reads, no RPC hop).
+
+Routes:
+  GET /api/nodes | /api/actors | /api/tasks | /api/objects
+      /api/placement_groups | /api/jobs | /api/cluster_summary
+  GET /api/metrics      (Prometheus text exposition)
+  GET /                 (tiny HTML index)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHttpServer:
+    def __init__(self, gcs):
+        self.gcs = gcs
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._on_client, host="127.0.0.1", port=port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------- serving
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2 or parts[0] != "GET":
+                await self._respond(writer, 405, b"method not allowed",
+                                    "text/plain")
+                return
+            path = parts[1].split("?", 1)[0]
+            # Drain headers (ignored).
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            await self._route(writer, path)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _respond(self, writer, status: int, body: bytes,
+                       ctype: str = "application/json"):
+        reason = {200: "OK", 404: "Not Found",
+                  405: "Method Not Allowed"}.get(status, "")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body)
+        await writer.drain()
+
+    async def _route(self, writer, path: str):
+        g = self.gcs
+        if path == "/":
+            body = (b"<html><body><h3>ray_tpu dashboard</h3><ul>" +
+                    b"".join(f'<li><a href="/api/{p}">{p}</a></li>'.encode()
+                             for p in ("nodes", "actors", "tasks", "objects",
+                                       "placement_groups", "jobs",
+                                       "cluster_summary", "metrics")) +
+                    b"</ul></body></html>")
+            await self._respond(writer, 200, body, "text/html")
+            return
+        if path == "/api/metrics":
+            await self._respond(writer, 200, self._prometheus().encode(),
+                                "text/plain; version=0.0.4")
+            return
+        data = None
+        if path == "/api/nodes":
+            data = [n.public() for n in g.nodes.values()]
+        elif path == "/api/actors":
+            data = [a.public() for a in g.actors.values()]
+        elif path == "/api/tasks":
+            data = list(g.task_events)
+        elif path == "/api/objects":
+            data = [{"object_id": oid, "owner": e.owner,
+                     "locations": sorted(e.nodes),
+                     "spilled": dict(e.spilled)}
+                    for oid, e in g.object_dir.items()]
+        elif path == "/api/placement_groups":
+            data = [pg.public() for pg in g.placement_groups.values()]
+        elif path == "/api/jobs":
+            data = list(g.jobs.values())
+        elif path == "/api/cluster_summary":
+            data = self._summary()
+        if data is None:
+            await self._respond(writer, 404, b'{"error": "not found"}')
+            return
+        await self._respond(writer, 200,
+                            json.dumps(data, default=str).encode())
+
+    def _summary(self) -> dict:
+        g = self.gcs
+        total: dict = {}
+        avail: dict = {}
+        for n in g.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0.0) + v
+        by_status: dict = {}
+        for ev in g.task_events:
+            by_status[ev.get("status", "?")] = \
+                by_status.get(ev.get("status", "?"), 0) + 1
+        return {
+            "time": time.time(),
+            "nodes": {"alive": sum(1 for n in g.nodes.values() if n.alive),
+                      "dead": sum(1 for n in g.nodes.values()
+                                  if not n.alive)},
+            "resources": {"total": total, "available": avail},
+            "actors": {"total": len(g.actors),
+                       "alive": sum(1 for a in g.actors.values()
+                                    if a.state == "ALIVE")},
+            "tasks": {"by_status": by_status},
+            "objects": len(g.object_dir),
+            "placement_groups": len(g.placement_groups),
+        }
+
+    def _prometheus(self) -> str:
+        """Cluster gauges + user metrics in Prometheus text exposition
+        (reference: metrics agent's OpenCensus->Prometheus export)."""
+        s = self._summary()
+        lines = [
+            "# TYPE ray_tpu_nodes_alive gauge",
+            f"ray_tpu_nodes_alive {s['nodes']['alive']}",
+            "# TYPE ray_tpu_actors_alive gauge",
+            f"ray_tpu_actors_alive {s['actors']['alive']}",
+            "# TYPE ray_tpu_objects_tracked gauge",
+            f"ray_tpu_objects_tracked {s['objects']}",
+        ]
+        def esc(v) -> str:
+            # Prometheus label-value escaping: backslash, quote, newline.
+            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
+        for k, v in s["resources"]["available"].items():
+            lines.append(
+                f'ray_tpu_resource_available{{resource="{esc(k)}"}} {v}')
+        for key, rec in getattr(self.gcs, "metrics", {}).items():
+            mname = "".join(c if c.isalnum() else "_"
+                            for c in rec.get("name", "m"))
+            labels = ",".join(f'{lk}="{esc(lv)}"' for lk, lv in
+                              (rec.get("labels") or {}).items())
+            lines.append(f"ray_tpu_user_{mname}{{{labels}}} "
+                         f"{rec.get('value', 0)}")
+        return "\n".join(lines) + "\n"

@@ -205,6 +205,7 @@ class Impala(Algorithm):
         self._loader = _LoaderThread(self._host_q, self._device_q)
         self._loader.start()
         self._inflight: Dict[str, Any] = {}   # ref hex -> (ref, worker)
+        self._in_pipeline = 0                 # batches put but not consumed
         self._updates = 0
         self.workers.ready()
         self._kick_all()
@@ -223,26 +224,45 @@ class Impala(Algorithm):
         target = c.get("num_batches_per_step", 4)
         while n_batches < target:
             if self._inflight:
+                # Harvest completed fragments ahead of need (bounded): the
+                # loader thread then converts batch k+1 to device arrays
+                # while the learner updates on batch k — a single-batch
+                # drain would serialize loader and learner.  The pipeline
+                # depth cap matters: host_q/device_q are bounded, and a
+                # blocking host_q.put from this (learner) thread with the
+                # loader blocked on device_q.put is a deadlock.
+                PIPELINE_DEPTH = 2
                 refs = [r for r, _ in self._inflight.values()]
-                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=120)
-                if not done:
-                    # Nothing completed within the poll window (slow jit
-                    # compile / starved host): re-poll rather than blocking
-                    # on an empty device queue forever.
-                    continue
+                if self._in_pipeline == 0:
+                    done, _ = ray_tpu.wait(refs, num_returns=1, timeout=120)
+                    if not done:
+                        # Nothing completed within the poll window (slow
+                        # jit compile / starved host): re-poll rather than
+                        # blocking on an empty device queue forever.
+                        continue
+                elif self._in_pipeline <= PIPELINE_DEPTH:
+                    done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                           timeout=0)
+                else:
+                    done = []
+                done = done[:max(0, PIPELINE_DEPTH + 1 -
+                                 self._in_pipeline)]
                 for ref in done:
                     _, worker = self._inflight.pop(ref.hex())
                     batch = ray_tpu.get(ref)
                     b, t = batch[REWARDS].shape
                     self._timesteps_total += b * t
                     self._host_q.put(batch)
+                    self._in_pipeline += 1
                     # Re-issue IMMEDIATELY: the actor never idles waiting
                     # for the learner (the async heart of IMPALA).
                     nref = worker.sample.remote()
                     self._inflight[nref.hex()] = (nref, worker)
             else:  # no remote workers: sample locally
                 self._host_q.put(self.workers.local_worker.sample())
+                self._in_pipeline += 1
             device_batch = self._device_q.get()
+            self._in_pipeline -= 1
             stats = policy.learn_on_batch(device_batch)
             n_batches += 1
             self._updates += 1

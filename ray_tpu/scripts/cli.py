@@ -73,6 +73,7 @@ def cmd_start(args):
         cmd += ["--resources", json.dumps(resources)]
     if args.head:
         cmd += ["--head", "--gcs-port", str(args.port),
+                "--dashboard-port", str(args.dashboard_port),
                 "--gcs-persist-path",
                 os.path.join(SESSION_DIR, "gcs_snapshot.json")]
     else:
@@ -105,6 +106,8 @@ def cmd_start(args):
     print(f"node started: node_id={info['node_id']} pid={proc.pid}")
     if args.head:
         print(f"GCS address: {info['gcs_address']}")
+        if info.get("dashboard_address"):
+            print(f"dashboard: http://{info['dashboard_address']}/")
         print(f"connect with: ray_tpu.init(address=\"{info['gcs_address']}\")"
               f"  # or RT_ADDRESS={info['gcs_address']}")
     if args.block:
@@ -238,6 +241,8 @@ def main(argv=None):
     sp.add_argument("--address", help="GCS address to join (worker nodes)")
     sp.add_argument("--port", type=int, default=6380,
                     help="GCS port (head only)")
+    sp.add_argument("--dashboard-port", type=int, default=8265,
+                    help="HTTP dashboard port (head only; -1 disables)")
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--resources", help="JSON resource dict")
     sp.add_argument("--object-store-memory", type=int,

@@ -1,0 +1,76 @@
+"""Head-node HTTP dashboard (REST over GCS state + Prometheus metrics).
+
+Reference analogs: dashboard REST modules + metrics agent exposition.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def dash_cluster():
+    info = ray_tpu.init(num_cpus=2, _worker_env={"JAX_PLATFORMS": "cpu"})
+    yield info
+    ray_tpu.shutdown()
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(f"http://{base}{path}",
+                                    timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_dashboard_endpoints(dash_cluster):
+    base = dash_cluster.get("dashboard_address")
+    assert base, f"no dashboard address in init info: {dash_cluster}"
+
+    @ray_tpu.remote
+    def traced():
+        return 42
+
+    assert ray_tpu.get(traced.remote()) == 42
+
+    status, body = _get(base, "/api/nodes")
+    assert status == 200
+    nodes = json.loads(body)
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+    status, body = _get(base, "/api/cluster_summary")
+    summary = json.loads(body)
+    assert summary["nodes"]["alive"] >= 1
+    assert "CPU" in summary["resources"]["total"]
+
+    deadline = time.monotonic() + 30
+    while True:
+        _, body = _get(base, "/api/tasks")
+        tasks = json.loads(body)
+        if any(t.get("name") == "traced" for t in tasks):
+            break
+        assert time.monotonic() < deadline, "task event never surfaced"
+        time.sleep(0.5)
+
+    status, body = _get(base, "/api/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "ray_tpu_nodes_alive 1" in text or \
+        "ray_tpu_nodes_alive" in text
+
+    status, body = _get(base, "/")
+    assert status == 200 and b"dashboard" in body
+
+    status, _ = _get(base, "/api/nope")
+    assert status == 404
+
+
+def test_dashboard_jobs_listing(dash_cluster):
+    base = dash_cluster.get("dashboard_address")
+    _, body = _get(base, "/api/jobs")
+    assert isinstance(json.loads(body), list)
