@@ -72,10 +72,11 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 
 
 def wait(refs: List[ObjectRef], *, num_returns: int = 1,
-         timeout: Optional[float] = None, fetch_local: bool = False):
-    """Metadata-only readiness (no value bytes move); fetch_local=True
-    additionally starts pulling ready remote objects to this node in the
-    background (reference: ray.wait fetch_local semantics)."""
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    """Readiness is metadata-only (deciding 'ready' never moves value
+    bytes); fetch_local=True (the reference's default) additionally starts
+    pulling ready remote objects to this node in the background so a
+    following get() is warm."""
     from ray_tpu._private.worker import get_core
     if not isinstance(refs, list):
         raise TypeError("wait() expects a list of ObjectRefs")

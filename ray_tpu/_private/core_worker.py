@@ -503,6 +503,13 @@ class CoreWorker:
                         self.plasma.release(oid)
                     return ("val", data)
             if entry is not None and entry[0] == "plasma":
+                if self.plasma is None:
+                    # Client mode (no local store): stream the bytes from a
+                    # holder node's raylet over TCP instead of pulling into
+                    # a plasma segment we don't have.
+                    data = await self._fetch_remote_bytes(h)
+                    if data is not None:
+                        return ("val", data)
                 ok = await self._pull_to_local(h)
                 if ok:
                     continue
@@ -529,6 +536,13 @@ class CoreWorker:
                     if reply["status"] == "error":
                         return ("err", reply["data"])
                     if reply["status"] == "plasma":
+                        if self.plasma is None:
+                            # Client mode: no store to pull into — stream
+                            # bytes from a holder before resorting to
+                            # (side-effectful) reconstruction.
+                            data = await self._fetch_remote_bytes(h)
+                            if data is not None:
+                                return ("val", data)
                         if await self._pull_to_local(h):
                             continue
                         # Copies lost: ask the owner to reconstruct from
@@ -536,8 +550,13 @@ class CoreWorker:
                         rec = await owner_conn.request(
                             {"type": "reconstruct_object", "object_id": h},
                             timeout=600)
-                        if rec.get("ok") and await self._pull_to_local(h):
-                            continue
+                        if rec.get("ok"):
+                            if self.plasma is None:
+                                data = await self._fetch_remote_bytes(h)
+                                if data is not None:
+                                    return ("val", data)
+                            elif await self._pull_to_local(h):
+                                continue
                 except ConnectionLost:
                     pass
                 # Owner gone (or reconstruction failed); try the object
@@ -593,6 +612,54 @@ class CoreWorker:
                 self._reconstructing.pop(oid.hex(), None)
             if not fut.done():
                 fut.set_result(False)
+
+    async def _fetch_remote_bytes(self, oid_hex: str) -> Optional[bytes]:
+        """Chunked fetch of a plasma object's bytes from any holder node's
+        raylet (Ray Client path: the driver has no shm store to pull
+        into)."""
+        try:
+            loc = await self.gcs.request({"type": "object_locations_get",
+                                          "object_id": oid_hex})
+            if not loc:
+                return None
+            nodes = await self._get_nodes_cached()
+        except Exception:
+            logger.debug("client-mode remote fetch of %s: directory lookup "
+                         "failed", oid_hex[:16], exc_info=True)
+            return None
+        holders = set(loc.get("nodes", [])) | set(loc.get("spilled", {}))
+        for n in nodes:
+            if n["node_id"] not in holders or not n["alive"]:
+                continue
+            # Per-holder isolation: a dead-but-still-listed node must not
+            # abort the fetch — try the next copy (same policy as the
+            # raylet's own pull path).
+            try:
+                conn = await self._get_worker_conn(n["address"])
+                first = await conn.request(
+                    {"type": "fetch_object", "object_id": oid_hex,
+                     "offset": 0}, timeout=120)
+                if not first.get("found"):
+                    continue
+                buf = bytearray(first["total"])
+                data = first["data"]
+                buf[0:len(data)] = data
+                pos = len(data)
+                while pos < first["total"]:
+                    chunk = await conn.request(
+                        {"type": "fetch_object", "object_id": oid_hex,
+                         "offset": pos}, timeout=120)
+                    if not chunk.get("found"):
+                        break
+                    d = chunk["data"]
+                    buf[pos:pos + len(d)] = d
+                    pos += len(d)
+                if pos >= first["total"]:
+                    return bytes(buf)
+            except Exception:
+                logger.debug("client-mode fetch of %s from %s failed",
+                             oid_hex[:16], n["address"], exc_info=True)
+        return None
 
     async def _pull_to_local(self, oid_hex: str) -> bool:
         if self.raylet is None or self.plasma is None:

@@ -1023,6 +1023,12 @@ class GcsServer:
         return {"ok": True}
 
     async def _h_list_metrics(self, conn, msg):
+        return self.aggregated_metrics()
+
+    def aggregated_metrics(self) -> List[dict]:
+        """Cluster-wide metric aggregation by (name, labels): counters sum,
+        gauges last-write-wins by report time, histogram buckets merge.
+        Shared by the list_metrics RPC and the dashboard exposition."""
         agg: Dict[tuple, dict] = {}
         for (name, labels, _pid), m in self.metrics.items():
             k = (name, labels)

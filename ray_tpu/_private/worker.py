@@ -68,6 +68,16 @@ class Worker:
             # Reference parity: RAY_ADDRESS -> RT_ADDRESS lets `job submit`
             # drivers and CLI tools connect without code changes.
             address = os.environ.get("RT_ADDRESS") or None
+        # Ray Client mode (reference: ray://host:port remote drivers via
+        # util/client/): the driver talks to the cluster purely over TCP —
+        # GCS + a remote raylet + owner-served object bytes — with no
+        # local shared-memory attach, so it can run on any machine that
+        # reaches the head.  Every runtime path already degrades cleanly
+        # when plasma is absent (inline owner store + owner get_object).
+        client_mode = False
+        if address and address.startswith("ray://"):
+            address = address[len("ray://"):]
+            client_mode = True
         if address is None:
             self._start_local_cluster(num_cpus, resources, object_store_memory,
                                       log_level, _worker_env)
@@ -82,7 +92,7 @@ class Worker:
         core = CoreWorker(
             gcs_address=gcs_address,
             raylet_address=info["raylet_address"],
-            store_name=info["store_name"],
+            store_name=None if client_mode else info["store_name"],
             node_id_hex=info["node_id"],
             job_id=self.job_id,
         )

@@ -157,19 +157,12 @@ class DashboardHttpServer:
             "# TYPE ray_tpu_objects_tracked gauge",
             f"ray_tpu_objects_tracked {s['objects']}",
         ]
-        def esc(v) -> str:
-            # Prometheus label-value escaping: backslash, quote, newline.
-            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
-                .replace("\n", "\\n")
-
+        from ray_tpu.util.metrics import _escape_label, render_prometheus
         for k, v in s["resources"]["available"].items():
-            lines.append(
-                f'ray_tpu_resource_available{{resource="{esc(k)}"}} {v}')
-        for key, rec in getattr(self.gcs, "metrics", {}).items():
-            mname = "".join(c if c.isalnum() else "_"
-                            for c in rec.get("name", "m"))
-            labels = ",".join(f'{lk}="{esc(lv)}"' for lk, lv in
-                              (rec.get("labels") or {}).items())
-            lines.append(f"ray_tpu_user_{mname}{{{labels}}} "
-                         f"{rec.get('value', 0)}")
-        return "\n".join(lines) + "\n"
+            lines.append(f'ray_tpu_resource_available'
+                         f'{{resource="{_escape_label(k)}"}} {v}')
+        # User metrics: reuse the GCS's (name, labels) aggregation and the
+        # shared exposition renderer — per-process raw records would emit
+        # duplicate series and drop histogram buckets.
+        user = render_prometheus(self.gcs.aggregated_metrics())
+        return "\n".join(lines) + "\n" + user

@@ -144,11 +144,18 @@ def collect() -> List[dict]:
     return get_core().gcs_request({"type": "list_metrics"})
 
 
-def prometheus_text() -> str:
-    """Standard Prometheus exposition of the aggregated metrics."""
+def _escape_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def render_prometheus(metrics: List[dict]) -> str:
+    """Prometheus text exposition of pre-aggregated metric records
+    (pure rendering — usable from the GCS-hosted dashboard where no
+    connected worker exists)."""
     lines = []
-    for m in collect():
-        labels = ",".join(f'{k}="{v}"' for k, v in
+    for m in metrics:
+        labels = ",".join(f'{k}="{_escape_label(v)}"' for k, v in
                           sorted(m["labels"].items()))
         lab = f"{{{labels}}}" if labels else ""
         if m["type"] == "histogram" and m.get("buckets"):
@@ -164,3 +171,8 @@ def prometheus_text() -> str:
         else:
             lines.append(f"{m['name']}{lab} {m['value']}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text() -> str:
+    """Standard Prometheus exposition of the aggregated metrics."""
+    return render_prometheus(collect())

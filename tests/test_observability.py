@@ -37,9 +37,11 @@ def test_list_tasks_records_executions(obs_cluster):
 
     assert ray_tpu.get([traced_add.remote(i, i) for i in range(4)]) == \
         [0, 2, 4, 6]
-    tasks = _wait_for(lambda: [t for t in state.list_tasks()
-                               if t["name"] == "traced_add"])
-    assert len(tasks) >= 4
+    def _all_four():
+        ts = [t for t in state.list_tasks() if t["name"] == "traced_add"]
+        return ts if len(ts) >= 4 else None  # event flushes are batched
+
+    tasks = _wait_for(_all_four)
     t = tasks[0]
     assert t["status"] == "FINISHED"
     assert t["end"] >= t["start"]

@@ -38,7 +38,8 @@ def _make_remote_blob():
 
 def test_wait_does_not_move_bytes(wait_cluster):
     ref = _make_remote_blob.remote()
-    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=120)
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=120,
+                                    fetch_local=False)
     assert ready == [ref] and not_ready == []
     # Readiness was metadata-only: the 16MB value is NOT in local plasma.
     assert not _core().plasma.contains(ref.id)
