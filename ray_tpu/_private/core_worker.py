@@ -506,9 +506,12 @@ class CoreWorker:
                 if self.plasma is None:
                     # Client mode (no local store): stream the bytes from a
                     # holder node's raylet over TCP instead of pulling into
-                    # a plasma segment we don't have.
+                    # a plasma segment we don't have.  Cache as a local
+                    # value so repeat gets don't re-stream (freed with the
+                    # ref like any inline entry).
                     data = await self._fetch_remote_bytes(h)
                     if data is not None:
+                        self._store_local(h, "val", data)
                         return ("val", data)
                 ok = await self._pull_to_local(h)
                 if ok:
@@ -627,7 +630,12 @@ class CoreWorker:
             logger.debug("client-mode remote fetch of %s: directory lookup "
                          "failed", oid_hex[:16], exc_info=True)
             return None
+        from ray_tpu._private.object_transfer import fetch_object_into
         holders = set(loc.get("nodes", [])) | set(loc.get("spilled", {}))
+
+        async def _alloc(total: int):
+            return bytearray(total)
+
         for n in nodes:
             if n["node_id"] not in holders or not n["alive"]:
                 continue
@@ -636,25 +644,8 @@ class CoreWorker:
             # raylet's own pull path).
             try:
                 conn = await self._get_worker_conn(n["address"])
-                first = await conn.request(
-                    {"type": "fetch_object", "object_id": oid_hex,
-                     "offset": 0}, timeout=120)
-                if not first.get("found"):
-                    continue
-                buf = bytearray(first["total"])
-                data = first["data"]
-                buf[0:len(data)] = data
-                pos = len(data)
-                while pos < first["total"]:
-                    chunk = await conn.request(
-                        {"type": "fetch_object", "object_id": oid_hex,
-                         "offset": pos}, timeout=120)
-                    if not chunk.get("found"):
-                        break
-                    d = chunk["data"]
-                    buf[pos:pos + len(d)] = d
-                    pos += len(d)
-                if pos >= first["total"]:
+                buf = await fetch_object_into(conn, oid_hex, _alloc)
+                if buf is not None:
                     return bytes(buf)
             except Exception:
                 logger.debug("client-mode fetch of %s from %s failed",

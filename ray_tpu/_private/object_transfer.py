@@ -1,0 +1,45 @@
+"""Chunked object fetch over the raylet fetch_object protocol.
+
+One shared implementation of the first-chunk-sizing / offset-advance /
+truncation-handling loop, used by both the raylet's node-to-node pull and
+the client-mode direct byte fetch (they had drifted apart and both carried
+an empty-chunk infinite-loop hazard).
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Optional
+
+
+async def fetch_object_into(conn, oid_hex: str,
+                            allocate: Callable[[int], Awaitable],
+                            timeout: float = 120) -> Optional[object]:
+    """Stream an object's bytes from a peer raylet into a buffer.
+
+    ``allocate(total)`` is awaited once with the object size and must
+    return a writable buffer (memoryview/bytearray).  Returns the filled
+    buffer, or None when the peer doesn't have the object or the transfer
+    truncates (evicted mid-transfer, or a short spill file serving empty
+    reads — an empty chunk MUST abort, not retry the same offset forever).
+    The caller owns buffer cleanup on None.
+    """
+    first = await conn.request(
+        {"type": "fetch_object", "object_id": oid_hex, "offset": 0},
+        timeout=timeout)
+    if not first.get("found"):
+        return None
+    total = first["total"]
+    buf = await allocate(total)
+    data = first["data"]
+    buf[0:len(data)] = data
+    pos = len(data)
+    while pos < total:
+        chunk = await conn.request(
+            {"type": "fetch_object", "object_id": oid_hex, "offset": pos},
+            timeout=timeout)
+        d = chunk.get("data") if chunk.get("found") else None
+        if not d:
+            return None
+        buf[pos:pos + len(d)] = d
+        pos += len(d)
+    return buf

@@ -876,43 +876,37 @@ class Raylet:
             return {"ok": False, "error": "no live remote location"}
         # A location can be stale (node just died, GCS hasn't noticed):
         # treat per-node connect/fetch failures as "try the next copy".
-        peer = first = None
+        from ray_tpu._private.object_transfer import fetch_object_into
+        allocated = []
+
+        async def _alloc(total: int):
+            b = await self._create_with_spill(oid, total)
+            allocated.append(b)
+            return b
+
+        done = False
         for addr in candidates:
+            if self.plasma.contains(oid):
+                return {"ok": True}
             try:
                 peer = await self._peer(addr)
-                first = await peer.request(
-                    {"type": "fetch_object",
-                     "object_id": msg["object_id"], "offset": 0},
-                    timeout=120)
-                if first.get("found"):
-                    break
+                buf = await fetch_object_into(
+                    peer, msg["object_id"], _alloc)
             except Exception as e:
                 logger.debug("pull %s from %s failed: %s",
                              msg["object_id"][:16], addr, e)
-            first = None
-        if first is None:
+                buf = None
+            if buf is not None:
+                done = True
+                break
+            if allocated:
+                # Truncated/evicted mid-transfer: free the half-written
+                # allocation and try the next holder.
+                self.plasma.release(oid)
+                self.plasma.delete(oid)
+                allocated.clear()
+        if not done:
             return {"ok": False, "error": "object missing at all locations"}
-        total = first["total"]
-        if self.plasma.contains(oid):
-            return {"ok": True}
-        buf = await self._create_with_spill(oid, total)
-        try:
-            data = first["data"]
-            buf[0:len(data)] = data
-            pos = len(data)
-            while pos < total:
-                chunk = await peer.request({"type": "fetch_object",
-                                            "object_id": msg["object_id"],
-                                            "offset": pos})
-                if not chunk.get("found"):
-                    raise RuntimeError("object evicted at remote mid-transfer")
-                d = chunk["data"]
-                buf[pos:pos + len(d)] = d
-                pos += len(d)
-        except Exception as e:
-            self.plasma.release(oid)
-            self.plasma.delete(oid)
-            return {"ok": False, "error": str(e)}
         self.plasma.seal(oid)
         self.plasma.release(oid)
         await self.gcs_conn.request({"type": "object_location_add",
