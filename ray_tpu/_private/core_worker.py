@@ -296,6 +296,10 @@ class CoreWorker:
             return {"status": "inline", "data": data}
         if kind == "err":
             return {"status": "error", "data": data}
+        # "plasma" and "cval" (a client-mode byte cache layered over a
+        # plasma object) both answer 'plasma': cluster workers must keep
+        # pulling node-to-node instead of streaming through the client
+        # driver's (possibly WAN) link.
         return {"status": "plasma"}
 
     # ------------------------------------------------------------ refcounts
@@ -454,7 +458,8 @@ class CoreWorker:
             await self.gcs.request({"type": "object_location_add",
                                     "object_id": h,
                                     "node_id": self.node_id_hex,
-                                    "owner": self.address})
+                                    "owner": self.address,
+                                    "size": ser.total_size})
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
         return self._run(self.get_objects_async(refs, timeout))
@@ -492,6 +497,8 @@ class CoreWorker:
             entry = self.memory_store.get(h)
             if entry is not None and entry[0] in ("val", "err"):
                 return entry
+            if entry is not None and entry[0] == "cval":
+                return ("val", entry[1])   # client-mode byte cache
             # Local shared-memory store.
             if self.plasma is not None:
                 view = self.plasma.get(oid)
@@ -511,7 +518,7 @@ class CoreWorker:
                     # ref like any inline entry).
                     data = await self._fetch_remote_bytes(h)
                     if data is not None:
-                        self._store_local(h, "val", data)
+                        self._store_local(h, "cval", data)
                         return ("val", data)
                 ok = await self._pull_to_local(h)
                 if ok:
@@ -545,6 +552,7 @@ class CoreWorker:
                             # (side-effectful) reconstruction.
                             data = await self._fetch_remote_bytes(h)
                             if data is not None:
+                                self._store_local(h, "cval", data)
                                 return ("val", data)
                         if await self._pull_to_local(h):
                             continue
@@ -557,6 +565,7 @@ class CoreWorker:
                             if self.plasma is None:
                                 data = await self._fetch_remote_bytes(h)
                                 if data is not None:
+                                    self._store_local(h, "cval", data)
                                     return ("val", data)
                             elif await self._pull_to_local(h):
                                 continue
@@ -914,6 +923,62 @@ class CoreWorker:
             self._node_view_cache = (now, nodes)
         return nodes
 
+    async def _locality_raylet(self, spec):
+        """Locality-aware lease target for the DEFAULT strategy (reference
+        lease_policy.h LocalityAwareLeasePolicy): lease from the node
+        holding the most of the task's plasma args — moving the task to
+        gigabytes beats moving gigabytes to the task.  Returns an
+        RpcConnection or None (meaning: use the local raylet)."""
+        ref_ids = [e[1] for e in
+                   list(spec.get("args", ())) +
+                   list((spec.get("kwargs") or {}).values())
+                   if isinstance(e, (list, tuple)) and e and e[0] == "ref"]
+        if not ref_ids:
+            return None
+        # Short-TTL location cache: thousands of small-task submissions
+        # must not serialize a GCS RPC each (reference answers this from
+        # owner-local locality data with no per-task RPC).
+        now = time.monotonic()
+        cache = getattr(self, "_loc_cache", None)
+        if cache is None:
+            cache = self._loc_cache = {}
+        missing = [r for r in ref_ids
+                   if r not in cache or now - cache[r][0] > 1.0]
+        if missing:
+            try:
+                fetched = await self.gcs.request(
+                    {"type": "object_locations_get_many",
+                     "object_ids": missing})
+            except Exception:
+                return None
+            for r in missing:
+                cache[r] = (now, (fetched or {}).get(r))
+            if len(cache) > 4096:
+                cache.clear()
+        # Weigh holders by BYTES, not ref count: one 16GB array must
+        # outvote three kilobyte-sized refs (lease_policy.h weighs by
+        # object size for the same reason).
+        tally: Dict[str, int] = {}
+        for r in ref_ids:
+            loc = cache.get(r, (0, None))[1]
+            if not loc:
+                continue
+            weight = max(int(loc.get("size", 0)), 1)
+            for nh in loc.get("nodes", []):
+                tally[nh] = tally.get(nh, 0) + weight
+        if not tally:
+            return None
+        best = max(tally, key=lambda nh: tally[nh])
+        if best == self.node_id_hex or \
+                tally[best] <= tally.get(self.node_id_hex or "", 0):
+            return None
+        nodes = await self._get_nodes_cached()
+        target = next((n for n in nodes
+                       if n["node_id"] == best and n["alive"]), None)
+        if target is None:
+            return None
+        return await self._get_worker_conn(target["address"])
+
     async def _submit_once(self, spec, resources, scheduling) -> dict:
         logger.debug("task %s %s: leasing", spec["task_id"][:8],
                      spec["name"])
@@ -949,6 +1014,12 @@ class CoreWorker:
                 self._spread_idx = getattr(self, "_spread_idx", 0) + 1
                 target = nodes[self._spread_idx % len(nodes)]
                 raylet = await self._get_worker_conn(target["address"])
+        elif not scheduling.get("placement_group_id"):
+            # DEFAULT strategy: data locality (spillback still applies if
+            # the arg-holding node is saturated).
+            locality = await self._locality_raylet(spec)
+            if locality is not None:
+                raylet = locality
         if scheduling.get("placement_group_id"):
             lease_msg["pg_id"] = scheduling["placement_group_id"]
             lease_msg["bundle_index"] = scheduling.get("bundle_index", 0) or 0
@@ -1256,5 +1327,6 @@ class CoreWorker:
         await self._plasma_put(oid, ser)
         await self.gcs.request({
             "type": "object_location_add", "object_id": h,
-            "node_id": self.node_id_hex, "owner": ""})
+            "node_id": self.node_id_hex, "owner": "",
+            "size": ser.total_size})
         return (h, "plasma", None)

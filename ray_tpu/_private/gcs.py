@@ -119,6 +119,7 @@ class ObjectDirEntry:
     owner: str
     nodes: Set[str] = field(default_factory=set)
     spilled: Dict[str, str] = field(default_factory=dict)  # node hex -> path
+    size: int = 0          # bytes (locality-aware lease weighting)
 
 
 @dataclass
@@ -896,11 +897,26 @@ class GcsServer:
         owner = msg.get("owner", "")
         entry = self.object_dir.get(oid)
         if entry is None:
-            self.object_dir[oid] = ObjectDirEntry(owner, {msg["node_id"]})
+            self.object_dir[oid] = ObjectDirEntry(
+                owner, {msg["node_id"]}, size=int(msg.get("size", 0)))
         else:
             entry.nodes.add(msg["node_id"])
             entry.spilled.pop(msg["node_id"], None)  # restored
+            if msg.get("size"):
+                entry.size = int(msg["size"])
         return {"ok": True}
+
+    async def _h_object_locations_get_many(self, conn, msg):
+        """Batch location lookup (locality-aware lease policy: one RPC per
+        task submission, not one per argument)."""
+        out = {}
+        for oid in msg["object_ids"]:
+            entry = self.object_dir.get(oid)
+            if entry is not None:
+                out[oid] = {"nodes": list(entry.nodes),
+                            "spilled": dict(entry.spilled),
+                            "size": entry.size}
+        return out
 
     async def _h_object_locations_get(self, conn, msg):
         entry = self.object_dir.get(msg["object_id"])

@@ -162,14 +162,9 @@ class DashboardHttpServer:
             lines.append(f'ray_tpu_resource_available'
                          f'{{resource="{_escape_label(k)}"}} {v}')
         # User metrics: reuse the GCS's (name, labels) aggregation and the
-        # shared exposition renderer — per-process raw records would emit
-        # duplicate series and drop histogram buckets.  Names are
-        # sanitized to Prometheus's [a-zA-Z0-9_:] charset under the
-        # ray_tpu_user_ prefix (one bad name must not poison the scrape).
-        def _metric_name(n: str) -> str:
-            return "ray_tpu_user_" + "".join(
-                c if (c.isalnum() or c in "_:") else "_" for c in n)
-
-        recs = [{**m, "name": _metric_name(m["name"])}
-                for m in self.gcs.aggregated_metrics()]
-        return "\n".join(lines) + "\n" + render_prometheus(recs)
+        # shared exposition renderer (which sanitizes names) — per-process
+        # raw records would emit duplicate series and drop histogram
+        # buckets, and any per-endpoint renaming would give one metric two
+        # series names depending on scrape point.
+        return "\n".join(lines) + "\n" + \
+            render_prometheus(self.gcs.aggregated_metrics())

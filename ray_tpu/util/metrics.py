@@ -149,12 +149,20 @@ def _escape_label(v) -> str:
         .replace("\n", "\\n")
 
 
+def _sanitize_name(n: str) -> str:
+    """Prometheus metric-name charset [a-zA-Z0-9_:]; applied in ONE place
+    so every exposition endpoint (dashboard, prometheus_text) emits the
+    same series name for the same metric."""
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in n)
+
+
 def render_prometheus(metrics: List[dict]) -> str:
     """Prometheus text exposition of pre-aggregated metric records
     (pure rendering — usable from the GCS-hosted dashboard where no
     connected worker exists)."""
     lines = []
     for m in metrics:
+        m = {**m, "name": _sanitize_name(m["name"])}
         labels = ",".join(f'{k}="{_escape_label(v)}"' for k, v in
                           sorted(m["labels"].items()))
         lab = f"{{{labels}}}" if labels else ""
