@@ -951,10 +951,12 @@ class CoreWorker:
                      "object_ids": missing})
             except Exception:
                 return None
-            for r in missing:
-                cache[r] = (now, (fetched or {}).get(r))
+            # Evict BEFORE inserting: clearing afterwards would wipe the
+            # entries this very submission is about to tally.
             if len(cache) > 4096:
                 cache.clear()
+            for r in missing:
+                cache[r] = (now, (fetched or {}).get(r))
         # Weigh holders by BYTES, not ref count: one 16GB array must
         # outvote three kilobyte-sized refs (lease_policy.h weighs by
         # object size for the same reason).

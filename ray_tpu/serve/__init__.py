@@ -88,8 +88,35 @@ def _controller() -> "ray_tpu.actor.ActorHandle":
 
 
 def run(target: Deployment, *, _blocking: bool = True) -> DeploymentHandle:
-    """Deploy (create or update) and return a handle
-    (reference: serve.run, api.py:455)."""
+    """Deploy (create or update) and return a handle.
+
+    Deployment graphs (reference: serve/dag.py + deployment_graph_build):
+    a Deployment bound as another deployment's init arg is deployed first
+    and replaced by its DeploymentHandle, so composed models call each
+    other through the router (`self.upstream.remote(x)`)."""
+    changed = False
+
+    def _materialize(v):
+        # Recurse through containers: a Deployment nested in a list/dict
+        # init arg must still be deployed and replaced by its handle —
+        # silently pickling the raw Deployment into the replica would only
+        # fail at first request time.
+        nonlocal changed
+        if isinstance(v, Deployment):
+            changed = True
+            run(v, _blocking=_blocking)
+            return get_handle(v.name)
+        if isinstance(v, (list, tuple)):
+            return type(v)(_materialize(x) for x in v)
+        if isinstance(v, dict):
+            return {k: _materialize(x) for k, x in v.items()}
+        return v
+
+    new_args = tuple(_materialize(a) for a in target._init_args)
+    new_kwargs = {k: _materialize(v)
+                  for k, v in target._init_kwargs.items()}
+    if changed:
+        target = target.bind(*new_args, **new_kwargs)
     ctrl = _controller()
     ray_tpu.get(ctrl.deploy.remote(target._spec()))
     if _blocking:

@@ -29,6 +29,12 @@ class DeploymentHandle:
         self._last_refresh = 0.0
         self._lock = threading.Lock()
 
+    def __reduce__(self):
+        # Handles travel into replicas for deployment graphs (a deployment
+        # bound with another deployment calls it through its handle); the
+        # lock and cached replica view rebuild fresh in the destination.
+        return (DeploymentHandle, (self._name, self._controller))
+
     def _refresh(self, force: bool = False):
         now = time.monotonic()
         if not force and now - self._last_refresh < REFRESH_PERIOD_S:

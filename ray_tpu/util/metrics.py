@@ -156,13 +156,16 @@ def _sanitize_name(n: str) -> str:
     return "".join(c if (c.isalnum() or c in "_:") else "_" for c in n)
 
 
-def render_prometheus(metrics: List[dict]) -> str:
+def render_prometheus(metrics: List[dict],
+                      prefix: str = "ray_tpu_user_") -> str:
     """Prometheus text exposition of pre-aggregated metric records
     (pure rendering — usable from the GCS-hosted dashboard where no
-    connected worker exists)."""
+    connected worker exists).  The shared default prefix namespaces user
+    metrics away from built-in ray_tpu_* series identically on every
+    exposition endpoint."""
     lines = []
     for m in metrics:
-        m = {**m, "name": _sanitize_name(m["name"])}
+        m = {**m, "name": prefix + _sanitize_name(m["name"])}
         labels = ",".join(f'{k}="{_escape_label(v)}"' for k, v in
                           sorted(m["labels"].items()))
         lab = f"{{{labels}}}" if labels else ""

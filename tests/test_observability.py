@@ -123,8 +123,10 @@ def test_metrics_counter_gauge_histogram(obs_cluster):
     assert hist["buckets"]["+Inf"] == 1
 
     text = metrics.prometheus_text()
-    assert 'rt_test_requests{route="/a"} 5.0' in text
-    assert "rt_test_latency_bucket" in text
+    # User metrics are namespaced away from built-in ray_tpu_* series,
+    # identically on every exposition endpoint.
+    assert 'ray_tpu_user_rt_test_requests{route="/a"} 5.0' in text
+    assert "ray_tpu_user_rt_test_latency_bucket" in text
 
 
 def test_metrics_aggregate_across_workers(obs_cluster):

@@ -222,3 +222,29 @@ def test_autoscaling_up(serve_cluster):
     finally:
         stop.set()
         t.join(timeout=30)
+
+
+def test_deployment_graph_composition(serve_cluster):
+    """A deployment bound with another deployment receives its handle
+    (reference: serve deployment graphs): Model calls Preprocessor
+    through the router."""
+    from ray_tpu import serve
+
+    @serve.deployment(name="graph_pre")
+    class Preprocessor:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(name="graph_model")
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            import ray_tpu
+            return ray_tpu.get(self.pre.remote(x)) + 1
+
+    handle = serve.run(Model.bind(Preprocessor.bind()))
+    assert ray_tpu.get(handle.remote(10), timeout=120) == 21
+    serve.delete("graph_model")
+    serve.delete("graph_pre")
