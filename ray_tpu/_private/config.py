@@ -47,6 +47,13 @@ class RtConfig:
     # -- retries --
     task_max_retries: int = 3
     actor_creation_attempts: int = 3
+    # A task whose args don't resolve within this window fails RETRIABLY,
+    # releasing its worker lease: consumers blocked on a lost object must
+    # not hold every CPU while the reconstruction task starves for a lease
+    # (resource deadlock; the reference resolves deps raylet-side before
+    # dispatching to a worker).  Generous: cancellation restarts the fetch,
+    # so the window must comfortably exceed legitimate large transfers.
+    arg_resolution_timeout_s: float = 120.0
 
     @classmethod
     def _from_env(cls) -> "RtConfig":

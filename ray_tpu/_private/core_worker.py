@@ -886,12 +886,19 @@ class CoreWorker:
                                 pinned_args=None):
         attempts = max_retries + 1
         last_err: Optional[BaseException] = None
-        for attempt in range(attempts):
+        attempt = 0
+        # System-level retriable failures (arg-resolution timeout releasing
+        # a lease under a lost-object deadlock) get their OWN budget: the
+        # function body never ran, so even max_retries=0 tasks are safe to
+        # re-push — the user budget is for application failures.
+        sys_budget = 10
+        while attempt < attempts:
             try:
                 reply = await self._submit_once(spec, resources, scheduling)
-            except ConnectionLost as e:
+            except ConnectionLost:
                 last_err = rex.WorkerCrashedError(
                     f"worker died executing task {spec['name']}")
+                attempt += 1
                 continue
             except Exception as e:  # scheduling failure etc.
                 last_err = e
@@ -899,9 +906,16 @@ class CoreWorker:
             if reply.get("ok"):
                 await self._store_task_returns(reply, return_ids)
                 return
+            if reply.get("retriable") and sys_budget > 0:
+                sys_budget -= 1
+                # Back off so the producing/reconstruction task can claim
+                # the freed CPU before we reoccupy it.
+                await asyncio.sleep(min(2.0 * (10 - sys_budget), 10.0))
+                continue       # does NOT consume a user attempt
             # Application error.
             if retry_exceptions and attempt < attempts - 1:
                 last_err = None
+                attempt += 1
                 continue
             for oid in return_ids:
                 self._store_local(oid.hex(), "err", reply["error"])
@@ -1213,16 +1227,24 @@ class CoreWorker:
         try:
             logger.debug("actor call %s.%s: resolving conn",
                          actor_id_hex[:8], call["method"])
-            conn = await self._actor_conn(actor_id_hex, st)
-            call = dict(call)
-            call["seq"] = st["seq"]
-            st["seq"] += 1
-            logger.debug("actor call %s.%s seq=%s: sending",
-                         actor_id_hex[:8], call["method"], call["seq"])
-            reply = await conn.request(call, timeout=None)
-            logger.debug("actor call %s.%s seq=%s: reply ok=%s",
-                         actor_id_hex[:8], call["method"], call["seq"],
-                         reply.get("ok"))
+            # System-retriable replies (arg-resolution timeout under a
+            # lost-object deadlock) resend with a fresh seq and their own
+            # bounded budget — the method body never ran.
+            for sys_attempt in range(11):
+                conn = await self._actor_conn(actor_id_hex, st)
+                sent = dict(call)
+                sent["seq"] = st["seq"]
+                st["seq"] += 1
+                logger.debug("actor call %s.%s seq=%s: sending",
+                             actor_id_hex[:8], call["method"], sent["seq"])
+                reply = await conn.request(sent, timeout=None)
+                logger.debug("actor call %s.%s seq=%s: reply ok=%s",
+                             actor_id_hex[:8], call["method"], sent["seq"],
+                             reply.get("ok"))
+                if reply.get("retriable") and sys_attempt < 10:
+                    await asyncio.sleep(min(2.0 * (sys_attempt + 1), 10.0))
+                    continue
+                break
             if reply.get("ok"):
                 await self._store_task_returns(reply, return_ids)
             else:

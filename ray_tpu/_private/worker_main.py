@@ -67,8 +67,20 @@ class TaskExecutor:
         status = "FINISHED"
         try:
             fn = await self.core.load_function(spec["fid"])
-            args, kwargs = await self.core.resolve_args(spec["args"],
-                                                        spec["kwargs"])
+            from ray_tpu._private.config import config as _rt_config
+            try:
+                args, kwargs = await asyncio.wait_for(
+                    self.core.resolve_args(spec["args"], spec["kwargs"]),
+                    timeout=_rt_config().arg_resolution_timeout_s)
+            except asyncio.TimeoutError:
+                # Retriable: give the lease back so reconstruction (or
+                # whatever produces the arg) can get a worker; the
+                # submitter retries with backoff.
+                status = "FAILED"
+                return {"ok": False, "retriable": True,
+                        "error": _serialize_exception(RuntimeError(
+                            "task argument resolution timed out; lease "
+                            "released for retry"))}
             loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
                 self.core.exec_pool, lambda: fn(*args, **kwargs))
@@ -122,8 +134,13 @@ class TaskExecutor:
         try:
             spec = cloudpickle.loads(msg["creation_spec"])
             cls = cloudpickle.loads(spec["cls"])
-            args, kwargs = await self.core.resolve_args(spec["args"],
-                                                       spec["kwargs"])
+            # Bounded like normal tasks: a creation blocked on a lost arg
+            # must release its worker so reconstruction can run (the GCS
+            # retries the creation on a fresh worker).
+            from ray_tpu._private.config import config as _rt_config
+            args, kwargs = await asyncio.wait_for(
+                self.core.resolve_args(spec["args"], spec["kwargs"]),
+                timeout=_rt_config().arg_resolution_timeout_s)
             self.max_concurrency = spec.get("max_concurrency", 1)
             self._sem = asyncio.Semaphore(self.max_concurrency)
             self.actor_id = msg["actor_id"]
@@ -158,8 +175,20 @@ class TaskExecutor:
             async with order["cond"]:
                 await order["cond"].wait_for(lambda: order["next"] >= seq)
             method = getattr(self.actor_instance, msg["method"])
-            args, kwargs = await self.core.resolve_args(msg["args"],
-                                                        msg["kwargs"])
+            from ray_tpu._private.config import config as _rt_config
+            try:
+                args, kwargs = await asyncio.wait_for(
+                    self.core.resolve_args(msg["args"], msg["kwargs"]),
+                    timeout=_rt_config().arg_resolution_timeout_s)
+            except asyncio.TimeoutError:
+                # Retriable: the caller resends with a fresh seq; advance
+                # the order cursor so later calls aren't blocked behind
+                # this one.
+                status = "FAILED"
+                await self._advance(order, seq)
+                return {"ok": False, "retriable": True,
+                        "error": _serialize_exception(RuntimeError(
+                            "actor-call argument resolution timed out"))}
             if inspect.iscoroutinefunction(method):
                 async with self._sem:
                     await self._advance(order, seq)

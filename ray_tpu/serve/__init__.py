@@ -94,29 +94,49 @@ def run(target: Deployment, *, _blocking: bool = True) -> DeploymentHandle:
     a Deployment bound as another deployment's init arg is deployed first
     and replaced by its DeploymentHandle, so composed models call each
     other through the router (`self.upstream.remote(x)`)."""
-    changed = False
+    import copy
+
+    def _has_dep(v) -> bool:
+        if isinstance(v, Deployment):
+            return True
+        if isinstance(v, (list, tuple)):
+            return any(_has_dep(x) for x in v)
+        if isinstance(v, dict):
+            return any(_has_dep(x) for x in v.values())
+        return False
 
     def _materialize(v):
         # Recurse through containers: a Deployment nested in a list/dict
         # init arg must still be deployed and replaced by its handle —
         # silently pickling the raw Deployment into the replica would only
-        # fail at first request time.
-        nonlocal changed
+        # fail at first request time.  Containers WITHOUT a nested
+        # Deployment pass through untouched (rebuilding would break tuple
+        # subclasses and drop dict-subclass state like default factories).
         if isinstance(v, Deployment):
-            changed = True
-            run(v, _blocking=_blocking)
-            return get_handle(v.name)
-        if isinstance(v, (list, tuple)):
-            return type(v)(_materialize(x) for x in v)
-        if isinstance(v, dict):
-            return {k: _materialize(x) for k, x in v.items()}
+            return run(v, _blocking=_blocking)
+        if not _has_dep(v):
+            return v
+        if isinstance(v, tuple):
+            items = [_materialize(x) for x in v]
+            return (v._replace(**dict(zip(v._fields, items)))
+                    if hasattr(v, "_fields") else tuple(items))
+        if isinstance(v, (list, dict)):
+            c = copy.copy(v)   # preserves subclass + its extra state
+            if isinstance(c, list):
+                for i, x in enumerate(c):
+                    c[i] = _materialize(x)
+            else:
+                for k in list(c):
+                    c[k] = _materialize(c[k])
+            return c
         return v
 
-    new_args = tuple(_materialize(a) for a in target._init_args)
-    new_kwargs = {k: _materialize(v)
-                  for k, v in target._init_kwargs.items()}
-    if changed:
-        target = target.bind(*new_args, **new_kwargs)
+    if any(_has_dep(v) for v in (*target._init_args,
+                                 *target._init_kwargs.values())):
+        target = target.bind(
+            *[_materialize(a) for a in target._init_args],
+            **{k: _materialize(v)
+               for k, v in target._init_kwargs.items()})
     ctrl = _controller()
     ray_tpu.get(ctrl.deploy.remote(target._spec()))
     if _blocking:

@@ -171,3 +171,29 @@ def test_pb2_learns_good_lr(ray_start):
     # exploitation averages much lower. Loose floor: PB2 exploit+GP explore
     # moved the population toward good lr.
     assert best.metrics["value"] > 20.0
+
+
+def test_bohb_combo_hyperband_with_tpe(ray_start):
+    """BOHB equivalent: HyperBand's bracketed halving driven by TPE's
+    model-based suggestions in one Tuner (reference tune/search/bohb
+    composes exactly these two roles)."""
+    def trainable(config):
+        v = 0.0
+        for _ in range(9):
+            v += 1.0 - (config["x"] - 0.5) ** 2
+            tune.report({"value": v})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(
+            metric="value", mode="max",
+            search_alg=TPESearcher(seed=5, n_startup_trials=4),
+            scheduler=HyperBandScheduler(max_t=9, reduction_factor=3),
+            num_samples=8, max_concurrent_trials=4),
+    )
+    results = tuner.fit()
+    assert len(results) == 8
+    best = results.get_best_result()
+    # Best trial ran to the cap with near-optimal x.
+    assert best.metrics["value"] > 7.0
