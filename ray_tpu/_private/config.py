@@ -54,6 +54,8 @@ class RtConfig:
     # dispatching to a worker).  Generous: cancellation restarts the fetch,
     # so the window must comfortably exceed legitimate large transfers.
     arg_resolution_timeout_s: float = 120.0
+    # -- logging --
+    log_poll_interval_s: float = 0.2        # worker log tail cadence
 
     @classmethod
     def _from_env(cls) -> "RtConfig":

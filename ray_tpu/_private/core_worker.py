@@ -999,7 +999,8 @@ class CoreWorker:
         logger.debug("task %s %s: leasing", spec["task_id"][:8],
                      spec["name"])
         raylet = self.raylet
-        lease_msg = {"type": "lease_worker", "resources": resources}
+        lease_msg = {"type": "lease_worker", "resources": resources,
+                     "job_id": self.job_id}
         if scheduling.get("runtime_env"):
             lease_msg["runtime_env"] = scheduling["runtime_env"]
             lease_msg["env_key"] = scheduling.get("env_key", "")

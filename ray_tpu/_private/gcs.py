@@ -563,6 +563,7 @@ class GcsServer:
             reply = await node.conn.request({
                 "type": "create_actor_worker",
                 "actor_id": actor.actor_id.hex(),
+                "job_id": actor.owner_job,
                 "creation_spec": actor.creation_spec,
                 "resources": actor.resources,
                 "pg_id": actor.scheduling.get("placement_group_id"),
@@ -992,6 +993,13 @@ class GcsServer:
         subs = self.subscribers.get(msg["channel"], [])
         if conn in subs:
             subs.remove(conn)
+        return {"ok": True}
+
+    async def _h_publish(self, conn, msg):
+        """Generic publish relay: raylets push worker-log batches (and any
+        future producer-defined channel) through the GCS fan-out
+        (reference pubsub/publisher.h GcsPublisher)."""
+        await self._publish(msg["channel"], msg["data"])
         return {"ok": True}
 
     # ------------------------------------------------- observability

@@ -69,6 +69,7 @@ class LeaseRequest:
     future: asyncio.Future = None
     runtime_env: Optional[dict] = None
     env_key: str = ""
+    job_id: Optional[str] = None
 
 
 class Raylet:
@@ -107,6 +108,13 @@ class Raylet:
         self.spill_dir = os.path.join(
             tempfile.gettempdir(), f"rt_spill_{node_id.hex()[:12]}")
         os.makedirs(self.spill_dir, exist_ok=True)
+        # Worker log capture (reference _private/log_monitor.py): every
+        # worker's stdout/stderr goes to per-process files in log_dir and a
+        # poll task tails them to the GCS "worker_logs" pubsub channel.
+        from ray_tpu._private.log_monitor import LogMonitor, default_log_dir
+        self.log_dir = default_log_dir(node_id.hex())
+        self.log_monitor = LogMonitor(
+            node_id=node_id.hex(), publish=self._publish_logs)
         self._spill_lock = asyncio.Lock()
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
@@ -139,7 +147,23 @@ class Raylet:
             self._pressure_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._memory_monitor_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._log_monitor_loop()))
         return port
+
+    async def _publish_logs(self, batch: dict) -> None:
+        if self.gcs_conn is not None:
+            await self.gcs_conn.notify({"type": "publish",
+                                        "channel": "worker_logs",
+                                        "data": batch})
+
+    async def _log_monitor_loop(self):
+        while not self._shutdown:
+            try:
+                await self.log_monitor.poll_once()
+            except Exception:
+                logger.debug("log monitor poll failed", exc_info=True)
+            await asyncio.sleep(config().log_poll_interval_s)
 
     async def close(self):
         self._shutdown = True
@@ -208,6 +232,8 @@ class Raylet:
             w.worker_id.hex()[:8], w.proc.returncode, w.actor_id,
             w.lease_id)
         self.workers.pop(w.worker_id, None)
+        # Final drain so a crashing worker's last prints reach the driver.
+        await self.log_monitor.unregister(w.worker_id.hex())
         pool = self.idle_workers.get(w.env_key)
         if pool and w in pool:
             pool.remove(w)
@@ -289,7 +315,8 @@ class Raylet:
 
     def _spawn_worker(self, actor_id: Optional[str] = None,
                       runtime_env: Optional[dict] = None,
-                      env_key: str = "") -> WorkerHandle:
+                      env_key: str = "",
+                      job_id: Optional[str] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -306,16 +333,31 @@ class Raylet:
             # working_dir/py_modules materialize in the worker after it
             # connects (it needs the GCS KV to fetch packages).
             env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env,
-            stdout=None,
-            stderr=None,
-        )
+        # Per-process log files, tailed to the driver by the log monitor
+        # (reference: worker stdout/stderr redirection in node.py +
+        # log_monitor.py).  Unbuffered so prints land promptly.
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        wid8 = worker_id.hex()[:12]
+        out_path = os.path.join(self.log_dir, f"worker-{wid8}.out")
+        err_path = os.path.join(self.log_dir, f"worker-{wid8}.err")
+        out_f = open(out_path, "ab", buffering=0)
+        err_f = open(err_path, "ab", buffering=0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env,
+                stdout=out_f,
+                stderr=err_f,
+            )
+        finally:
+            out_f.close()
+            err_f.close()
         w = WorkerHandle(worker_id=worker_id, proc=proc, actor_id=actor_id,
                          env_key=env_key,
                          ready=asyncio.get_running_loop().create_future())
         self.workers[worker_id] = w
+        self.log_monitor.register(worker_id.hex(), proc.pid, out_path,
+                                  err_path, actor_id=actor_id, job_id=job_id)
         return w
 
     async def _get_idle_worker(self, runtime_env: Optional[dict] = None,
@@ -347,7 +389,8 @@ class Raylet:
         w = None
         try:
             w = self._spawn_worker(actor_id=msg["actor_id"],
-                                   runtime_env=msg.get("runtime_env"))
+                                   runtime_env=msg.get("runtime_env"),
+                                   job_id=msg.get("job_id"))
             w.actor_resources = (resources, pg_id, msg.get("bundle_index", 0))
             logger.debug("actor %s: spawned worker %s pid=%s, waiting ready",
                          msg["actor_id"][:8], w.worker_id.hex()[:8],
@@ -389,6 +432,9 @@ class Raylet:
                     still.proc.terminate()
                 except Exception:
                     pass
+                # _on_worker_death won't run for an untracked worker — drain
+                # its final output (constructor traceback!) and stop tailing.
+                await self.log_monitor.unregister(still.worker_id.hex())
             raise
 
     async def _kill_actor_worker(self, msg: dict) -> dict:
@@ -480,6 +526,7 @@ class Raylet:
             future=asyncio.get_running_loop().create_future(),
             runtime_env=msg.get("runtime_env"),
             env_key=msg.get("env_key", ""),
+            job_id=msg.get("job_id"),
         )
         if not self._fits(req):
             # Hybrid policy (reference hybrid_scheduling_policy.h:24-47):
@@ -566,6 +613,9 @@ class Raylet:
         w.lease_id = lease_id
         w.busy = True
         w.busy_since = time.monotonic()
+        # Tag the worker's log streams with the leasing job so drivers can
+        # filter echoes to their own job (reference print_logs job filter).
+        self.log_monitor.set_job(w.worker_id.hex(), req.job_id)
         return {"worker_address": w.address, "lease_id": lease_id,
                 "worker_id": w.worker_id.hex(),
                 "resources": req.resources, "pg_id": req.pg_id,
@@ -584,6 +634,7 @@ class Raylet:
             if w is not None and w.proc.poll() is None:
                 w.lease_id = None
                 w.busy = False
+                self.log_monitor.set_job(w.worker_id.hex(), None)
                 # Idle cap scales with node CPUs: spawning a worker costs
                 # ~1.5s of CPU (jax import) while an idle worker is nearly
                 # free, so tearing down above a tiny fixed cap thrashes

@@ -47,6 +47,7 @@ class Worker:
         namespace: Optional[str] = None,
         object_store_memory: Optional[int] = None,
         log_level: str = "WARNING",
+        log_to_driver: bool = True,
         _worker_env: Optional[Dict[str, str]] = None,
         _system_config: Optional[Dict[str, Any]] = None,
     ):
@@ -100,6 +101,18 @@ class Worker:
         self.mode = "driver"
         core.gcs_request({"type": "register_job", "job_id": self.job_id,
                           "driver_address": core.address})
+        if log_to_driver:
+            # Echo worker stdout/stderr on this console, filtered to this
+            # job (reference: ray_logging.print_logs' job_id filter).
+            # Untagged batches (idle workers, nested-task workers) pass.
+            from ray_tpu._private.log_monitor import print_to_driver
+            my_job = self.job_id
+
+            def _echo(batch, _job=my_job):
+                if batch.get("job_id") in (None, _job):
+                    print_to_driver(batch)
+
+            core.subscribe("worker_logs", _echo)
         atexit.register(self.shutdown)
         return self.connection_info()
 

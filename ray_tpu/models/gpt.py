@@ -34,7 +34,11 @@ class GPTConfig:
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16        # compute dtype (params stay f32)
     remat: bool = True
-    attention: str = "dense"         # "dense" | "ring" (ring needs sp>1)
+    # "full" recomputes the whole block in bwd (min memory); "dots" saves
+    # matmul outputs and recomputes only elementwise ops (good middle
+    # ground when activations fit HBM).
+    remat_policy: str = "full"       # "full" | "dots"
+    attention: str = "dense"   # "dense" | "flash" | "ring" (ring needs sp>1)
     # MoE (0 = dense FFN).  Experts shard over the ep mesh axis; routing is
     # GShard/Switch-style capacity-bounded dispatch (ray_tpu/ops/moe.py).
     num_experts: int = 0
@@ -214,7 +218,9 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
 def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
                          cfg: GPTConfig,
                          rules: Optional[LogicalAxisRules] = None,
-                         mesh=None) -> Tuple[jax.Array, jax.Array]:
+                         mesh=None,
+                         keep_dtype: bool = False
+                         ) -> Tuple[jax.Array, jax.Array]:
     """tokens [B, S] int32 -> (logits [B, S, V] f32, moe_aux_loss scalar).
 
     Layers run under one `lax.scan` over the stacked [L] params — XLA sees a
@@ -245,7 +251,9 @@ def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
 
     block = functools.partial(_block, cfg, rules, attn_fn)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        block = jax.checkpoint(block, policy=policy)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params)
@@ -253,7 +261,11 @@ def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     x, aux = jax.lax.scan(scan_body, x, params["layers"])
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt))
-    return logits.astype(jnp.float32), jnp.sum(aux)
+    # keep_dtype avoids materializing [B,S,V] in f32 (6.6GB of HBM traffic
+    # at bench scale) — the fused loss upcasts inside its reductions.
+    if not keep_dtype:
+        logits = logits.astype(jnp.float32)
+    return logits, jnp.sum(aux)
 
 
 def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
@@ -277,12 +289,19 @@ def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
     aux = jnp.zeros((), jnp.float32)
     if forward_fn is None:
         logits, aux = gpt_forward_with_aux(params, toks[:, :-1], cfg, rules,
-                                           mesh)
+                                           mesh, keep_dtype=True)
     else:
         logits = forward_fn(params, toks[:, :-1])
     targets = toks[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # Fused cross-entropy: ll_i = logit[target_i] - logsumexp(logits_i),
+    # written so XLA fuses the f32 upcast into the reductions and never
+    # materializes an f32 [B,S,V] tensor.
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    z = (logits - m).astype(jnp.float32)
+    lse = jnp.log(jnp.sum(jnp.exp(z), axis=-1)) + m[..., 0].astype(
+        jnp.float32)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ll = tgt.astype(jnp.float32) - lse
     return -jnp.mean(ll) + cfg.moe_aux_coef * aux
 
 

@@ -1,78 +1,137 @@
-"""Flash (blockwise, online-softmax) causal attention as a Pallas TPU kernel.
+"""Flash (blockwise, online-softmax) causal attention as Pallas TPU kernels.
 
 The reference has no fused attention of its own (it defers to torch); on TPU
 the memory-bound step is reading the [S, S] score matrix from HBM, so we
-never materialize it: the kernel streams K/V blocks through VMEM, keeping the
-running max/denominator in f32 scratch (the FlashAttention recurrence), and
-writes only the [block_q, head_dim] output tile.  Grid = (batch*heads,
-q_blocks); K/V blocks iterate in the innermost grid dim so Pallas
-double-buffers their HBM->VMEM DMAs automatically.
+never materialize it.  All three kernels use a 3-D grid — (batch*heads,
+out_block, streamed_block) — with the streamed operand (K/V for the q-side
+kernels, Q/dO for the k-side kernel) delivered one VMEM tile per inner grid
+step, so VMEM stays O(block) no matter the sequence length; Pallas
+double-buffers the inner-dim DMAs automatically.  Online-softmax /
+gradient accumulators live in f32 VMEM scratch across inner steps.
 
-Backward pass: fwd is wrapped in `jax.custom_vjp` with a recompute-based bwd
-(dense blockwise attention under `jax.checkpoint` semantics) — correct
-gradients, O(S) memory off-chip.
+Backward is the FlashAttention-2 recurrence, also in Pallas — NOT a dense
+vjp.  Residuals are q, k, v, o, lse (all O(S) off-chip).  Two kernels:
 
-On non-TPU backends the same kernel runs in Pallas interpret mode, keeping
+  * dq kernel    — grid over q blocks; streams K/V blocks, recomputes the
+    probability tile from (q, k, lse) and accumulates dq.
+  * dk/dv kernel — grid over k blocks; streams Q/dO blocks, recomputes the
+    probability tile and accumulates dk and dv via dim-0 contractions
+    (implicitly-transposed matmuls the MXU executes natively).
+
+lse and D ride into the kernels as [*, seq, _LANES] tiles (row value
+broadcast along a narrow minor dim) so they slice as native sublane column
+vectors — the same layout trick as jax.experimental.pallas.ops.tpu
+.flash_attention's l/m tensors, but 8 lanes wide instead of 128.
+
+Causal skipping: dead diagonal blocks are jumped with `pl.when`, so the
+wall-clock cost of the mask is ~half the non-causal kernel, not equal to it.
+
+On non-TPU backends the same kernels run in Pallas interpret mode, keeping
 CPU tests honest.
+
+Design analog: the reference defers attention to torch SDPA/flash-attn CUDA
+kernels; this is the TPU-native replacement (SURVEY §5.7).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LANES = 8     # minor-dim width of the lse/D carrier tensors
+_SCR = 128     # lane width of VMEM scratch accumulators
+
+# (backend, B, S, N, H, dtype, causal) -> (block_q, block_k); filled by
+# tune_flash_blocks and consulted when callers pass block_q/block_k = None.
+_TUNED: dict = {}
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                 sm_scale: float, seq_len: int):
-    # q_ref: [block_q, H]; k_ref/v_ref: [S, H]; o_ref: [block_q, H]
+def _default_blocks(S: int, H: int) -> tuple:
+    """Heuristic block sizes: large blocks amortize the K/V stream and the
+    grid launch; 128-lane alignment keeps the MXU full.  Overridable via
+    RT_FLASH_BLOCK_Q / RT_FLASH_BLOCK_K or per-call arguments."""
+    # Swept on v5e (see round-3 notes): 1024x1024 wins at every S in
+    # {1024..8192} — the [bq,bk] f32 probability tile (4MB) still fits VMEM
+    # and larger tiles amortize the grid/DMA overhead.
+    bq = int(os.environ.get("RT_FLASH_BLOCK_Q", 0)) or 1024
+    bk = int(os.environ.get("RT_FLASH_BLOCK_K", 0)) or 1024
+    # Halve until the block divides S (terminates at 1, which always
+    # divides — Mosaic itself rejects sub-tile blocks on TPU, so odd S
+    # values that can't reach a >=8 block need padding by the caller).
+    while S % bq:
+        bq //= 2
+    while S % bk:
+        bk //= 2
+    return bq, bk
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
+                causal: bool, sm_scale: float):
+    # q_ref: [block_q, H]; k_ref/v_ref: [block_k, H] (streamed on grid dim 2)
+    # o_ref: [block_q, H]; lse_ref: [block_q, _LANES]
+    # scratch: m/l [block_q, _SCR], o [block_q, H] — all f32
     block_q, head_dim = q_ref.shape
-    qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    block_k = k_ref.shape[0]
+    qi, kb = pl.program_id(1), pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_start, k_start = qi * block_q, kb * block_k
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    o0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full((block_q, _SCR), _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros((block_q, _SCR), jnp.float32)
+        o_scr[:] = jnp.zeros((block_q, head_dim), jnp.float32)
 
-    num_kb = seq_len // block_k
-    q_start = qi * block_q
+    live = True if not causal else k_start <= q_start + block_q - 1
 
-    def body(kb, carry):
-        m, l, o = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _compute():
+        # matmuls run in the input dtype (bf16-native on the MXU) with f32
+        # accumulation; softmax statistics stay f32.
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            rows = q_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
+            cols = k_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l, o
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[:] = o_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
 
-    if causal:
-        # skip key blocks entirely above the diagonal
-        num_live = jax.lax.div(q_start + block_q - 1, block_k) + 1
-        m, l, o = jax.lax.fori_loop(0, num_live, body, (m0, l0, o0))
-    else:
-        m, l, o = jax.lax.fori_loop(0, num_kb, body, (m0, l0, o0))
-    o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[:] = (o_scr[:] / l).astype(o_ref.dtype)
+        lse = m_scr[:, 0:1] + jnp.log(l)
+        lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
 def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
                     sm_scale: Optional[float], interpret: bool):
-    """q,k,v: [B, S, N, H] -> o: [B, S, N, H]."""
+    """q,k,v: [B, S, N, H] -> (o: [B, S, N, H], lse: [B*N, S] f32)."""
     B, S, N, H = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
     block_q = min(block_q, S)
@@ -85,23 +144,210 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
         return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
 
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
-    kernel = functools.partial(
-        _attn_kernel, block_k=block_k, causal=causal, sm_scale=scale,
-        seq_len=S)
-    of = pl.pallas_call(
+    kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=scale)
+    of, lse = pl.pallas_call(
         kernel,
-        grid=(B * N, S // block_q),
+        grid=(B * N, S // block_q, S // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, S, H), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, S, H), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+            jax.ShapeDtypeStruct((B * N, S, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _SCR), jnp.float32),
+            pltpu.VMEM((block_q, _SCR), jnp.float32),
+            pltpu.VMEM((block_q, H), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return of.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+    return (of.reshape(B, N, S, H).transpose(0, 2, 1, 3), lse[:, :, 0])
 
+
+# ---------------------------------------------------------------- backward
+#
+# FlashAttention-2 recurrence.  With P = exp(S*scale - lse) the true softmax
+# probabilities and D_i = sum_h dO_ih * O_ih:
+#   dV = P^T dO;   dP = dO V^T;   dS = P * (dP - D) * scale
+#   dQ = dS K;     dK = dS^T Q
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal: bool, sm_scale: float):
+    # q_ref/do_ref/dq_ref: [block_q, H]; k_ref/v_ref: [block_k, H] (streamed);
+    # lse_ref/delta_ref: [block_q, _LANES]; dq_scr: [block_q, H] f32
+    block_q, head_dim = q_ref.shape
+    block_k = k_ref.shape[0]
+    qi, kb = pl.program_id(1), pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_start, k_start = qi * block_q, kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    live = True if not causal else k_start <= q_start + block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0:1]
+        delta = delta_ref[:, 0:1]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = lax.dot_general(                       # q @ k^T
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = lax.dot_general(                      # do @ v^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, causal: bool, sm_scale: float):
+    # k_ref/v_ref/dk_ref/dv_ref: [block_k, H]; q_ref/do_ref: [block_q, H]
+    # (streamed); lse_ref/delta_ref: [block_q, _LANES]
+    block_k, head_dim = k_ref.shape
+    block_q = q_ref.shape[0]
+    ki, jb = pl.program_id(1), pl.program_id(2)
+    num_qb = pl.num_programs(2)
+    k_start, q_start = ki * block_k, jb * block_q
+
+    @pl.when(jb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros((block_k, head_dim), jnp.float32)
+        dv_scr[:] = jnp.zeros((block_k, head_dim), jnp.float32)
+
+    live = True if not causal else q_start + block_q - 1 >= k_start
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[:]
+        v = v_ref[:]
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0:1]
+        delta = delta_ref[:, 0:1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        # dv += p^T @ do   (contract dim 0 of both: implicit transpose)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jb == num_qb - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, *, causal: bool, block_q: int,
+                    block_k: int, sm_scale: Optional[float], interpret: bool):
+    B, S, N, H = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+
+    def _fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+
+    assert S % block_q == 0 and S % block_k == 0, (
+        f"seq {S} must divide blocks ({block_q},{block_k})")
+    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(g)
+    # D_i = sum_h dO_ih O_ih — cheap elementwise reduce, leave it to XLA.
+    delta = jnp.sum(dof.astype(jnp.float32) *
+                    _fold(o).astype(jnp.float32), axis=-1)      # [B*N, S]
+    lse_l = jnp.broadcast_to(lse[:, :, None], (B * N, S, _LANES))
+    delta_l = jnp.broadcast_to(delta[:, :, None], (B * N, S, _LANES))
+
+    dq_kernel = functools.partial(_dq_kernel, causal=causal, sm_scale=scale)
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(B * N, S // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, H), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_l, delta_l)
+
+    dkv_kernel = functools.partial(_dkv_kernel, causal=causal, sm_scale=scale)
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * N, S // block_k, S // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, H), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, H), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+            jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, H), jnp.float32),
+            pltpu.VMEM((block_k, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse_l, delta_l)
+
+    def _unfold(x):
+        return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+
+    return _unfold(dqf), _unfold(dkf), _unfold(dvf)
+
+
+# ---------------------------------------------------------------- public API
 
 def _dense_reference(q, k, v, causal, sm_scale):
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
@@ -115,28 +361,96 @@ def _dense_reference(q, k, v, causal, sm_scale):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, sm_scale: Optional[float] = None,
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    sm_scale: Optional[float] = None,
                     interpret: Optional[bool] = None):
-    """Fused causal attention. q,k,v: [batch, seq, heads, head_dim]."""
+    """Fused causal attention. q,k,v: [batch, seq, heads, head_dim].
+
+    block_q/block_k default to a per-shape heuristic (see _default_blocks)
+    and honor any entry recorded by `tune_flash_blocks`.
+    """
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret)
+    return out
+
+
+def _resolve(q, causal, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_fwd_impl(q, k, v, causal=causal, block_q=block_q,
-                           block_k=block_k, sm_scale=sm_scale,
-                           interpret=interpret)
+    if block_q is None or block_k is None:
+        B, S, N, H = q.shape
+        key = (jax.default_backend(), B, S, N, H, str(q.dtype), causal)
+        bq, bk = _TUNED.get(key) or _default_blocks(S, H)
+        block_q = block_q or bq
+        block_k = block_k or bk
+    return block_q, block_k, interpret
 
 
 def _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, sm_scale,
-                          interpret)
-    return out, (q, k, v)
+    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret)
+    out, lse = _flash_fwd_impl(q, k, v, causal=causal, block_q=bq,
+                               block_k=bk, sm_scale=sm_scale,
+                               interpret=interp)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, sm_scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, causal, sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret)
+    return _flash_bwd_impl(q, k, v, o, lse, g, causal=causal, block_q=bq,
+                           block_k=bk, sm_scale=sm_scale, interpret=interp)
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+def tune_flash_blocks(B, S, N, H, dtype=jnp.bfloat16, causal=True,
+                      candidates=(128, 256, 512), steps=3):
+    """Time fwd+bwd for each (block_q, block_k) candidate pair on the live
+    backend and record the winner for subsequent block_q=None calls.
+
+    Returns ((block_q, block_k), best_seconds_per_step).
+    """
+    import time
+
+    key = (jax.default_backend(), B, S, N, H, str(jnp.dtype(dtype)), causal)
+    if key in _TUNED:
+        return _TUNED[key], None
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+    kk = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+    vv = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+    best, best_t = None, float("inf")
+    for bq in candidates:
+        for bk in candidates:
+            if S % bq or S % bk or bq > S or bk > S:
+                continue
+
+            def loss(q, k, v):
+                return flash_attention(q, k, v, causal, bq, bk).astype(
+                    jnp.float32).sum()
+
+            f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+            def _sync(r):
+                # block_until_ready is unreliable through the axon tunnel;
+                # pulling one scalar forces completion.
+                float(jnp.asarray(r[0])[0, 0, 0, 0])
+
+            try:
+                r = f(q, kk, vv)  # compile + warm
+                _sync(r)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    r = f(q, kk, vv)
+                _sync(r)
+                dt = (time.perf_counter() - t0) / steps
+            except Exception:
+                continue
+            if dt < best_t:
+                best, best_t = (bq, bk), dt
+    if best is None:
+        best = _default_blocks(S, H)
+    _TUNED[key] = best
+    return best, best_t

@@ -1,0 +1,59 @@
+"""Quick flash vs dense fwd+bwd timing on the live backend.
+
+Usage: python scripts/flashbench.py [S] [bq] [bk]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.flash_attention import _dense_reference, flash_attention
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+bq = int(sys.argv[2]) if len(sys.argv) > 2 else None
+bk = int(sys.argv[3]) if len(sys.argv) > 3 else None
+B, N, H = 2, 12, 64
+dtype = jnp.bfloat16
+
+print("backend:", jax.default_backend(), flush=True)
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+k = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+v = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
+
+
+def timeit(f, n=5):
+    r = f(q, k, v)
+    float(jnp.asarray(r[0]).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(q, k, v)
+    float(jnp.asarray(r[0]).reshape(-1)[0])
+    return (time.perf_counter() - t0) / n
+
+
+def loss_flash(q, k, v):
+    return flash_attention(q, k, v, True, bq, bk).astype(jnp.float32).sum()
+
+
+def loss_dense(q, k, v):
+    return _dense_reference(q, k, v, True, None).astype(jnp.float32).sum()
+
+
+gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
+
+tf = timeit(gf)
+print(f"flash fwd+bwd S={S} blocks=({bq},{bk}): {tf*1e3:.2f} ms", flush=True)
+td = timeit(gd)
+print(f"dense fwd+bwd S={S}: {td*1e3:.2f} ms  flash_speedup={td/tf:.2f}x",
+      flush=True)
+
+# correctness spot-check vs dense in f32
+q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+of = flash_attention(q32, k32, v32, True, bq, bk)
+od = _dense_reference(q32, k32, v32, True, None)
+err = float(jnp.max(jnp.abs(of - od)))
+print("max fwd err vs dense (f32):", err, flush=True)
