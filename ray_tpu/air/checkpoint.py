@@ -109,6 +109,26 @@ class Checkpoint:
             raise ValueError("checkpoint holds no pytree")
         return data["__pytree__"]
 
+    # -- URI storage ------------------------------------------------------
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """Fetch a checkpoint from URI storage (file://, gs://, ...) into a
+        fresh local directory (reference air/checkpoint.py:63 from_uri).
+        The directory is reaped at interpreter exit — preemption-retry
+        loops calling from_uri repeatedly must not fill local disk."""
+        from ray_tpu.air.storage import get_provider
+        dest = os.path.join(tempfile.gettempdir(),
+                            f"rt_checkpoint_{uuid.uuid4().hex[:12]}")
+        get_provider(uri).download_dir(uri, dest)
+        _reap_at_exit(dest)
+        return cls.from_directory(dest)
+
+    def to_uri(self, uri: str) -> str:
+        """Upload the directory form to URI storage and return the URI."""
+        from ray_tpu.air.storage import get_provider
+        get_provider(uri).upload_dir(self.to_directory(), uri)
+        return uri
+
     # -- misc -------------------------------------------------------------
     @property
     def path(self) -> Optional[str]:
@@ -147,6 +167,21 @@ class Checkpoint:
         # Serialize through the dict form so checkpoints travel through the
         # object store regardless of which node's filesystem they live on.
         return (Checkpoint.from_dict, (self.to_dict(),))
+
+
+_REAP_DIRS: list = []
+
+
+def _reap_at_exit(path: str) -> None:
+    if not _REAP_DIRS:
+        import atexit
+
+        def _reap():
+            for p in _REAP_DIRS:
+                shutil.rmtree(p, ignore_errors=True)
+
+        atexit.register(_reap)
+    _REAP_DIRS.append(path)
 
 
 # -- pytree <-> directory ------------------------------------------------

@@ -29,9 +29,16 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401
     LogicalAxisRules,
+    init_sharded,
     logical_sharding,
     shard_params,
     with_logical_constraint,
+)
+from ray_tpu.parallel.multislice import (  # noqa: F401
+    assert_slice_aligned,
+    dcn_axes,
+    ici_axes,
+    slice_mesh,
 )
 from ray_tpu.parallel.collectives import (  # noqa: F401
     all_gather,

@@ -1,0 +1,114 @@
+"""Multi-slice meshes: dp over DCN, tp/sp/pp/ep/fsdp inside each slice.
+
+Design analog: the reference scales past one machine by layering DDP over
+NCCL rings per node (``train/torch/config.py`` + NCCL groups); the TPU
+equivalent is a multi-controller JAX program (one process per host/slice,
+``jax.distributed.initialize``) with a single global Mesh whose OUTERMOST
+axis crosses slice boundaries.  ICI only exists within a slice, so the
+axis layout is a correctness-of-performance contract:
+
+  * dp (gradient allreduce, latency-tolerant, once per step) -> DCN
+  * fsdp/pp/ep/sp/tp (per-layer gathers/exchanges)            -> ICI
+
+``slice_mesh`` builds that mesh: devices are grouped process-major, the dp
+axis enumerates (slice, dp_per_slice) with slice as the outer factor, and
+every inner-axis neighborhood stays inside one slice.  This is the "How to
+Scale Your Model" recipe (dp across pods, model axes within) expressed as
+one helper.  ``assert_slice_aligned`` verifies the invariant against the
+actual device.process_index values.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.parallel.mesh import AXIS_ORDER, MeshSpec
+
+
+def slice_mesh(*, num_slices: Optional[int] = None, dp_per_slice: int = 1,
+               fsdp: Optional[int] = None, pp: int = 1, ep: int = 1,
+               sp: int = 1, tp: int = 1,
+               devices: Optional[Sequence] = None
+               ) -> Tuple["jax.sharding.Mesh", MeshSpec]:
+    """Build a slice-aligned global mesh; returns (mesh, spec).
+
+    num_slices defaults to ``jax.process_count()`` (one controller process
+    per slice).  fsdp=None auto-fills the per-slice residual.  The returned
+    spec has ``dp = num_slices * dp_per_slice`` — LogicalAxisRules built
+    for it apply unchanged, so the same model/trainer code runs single- or
+    multi-slice.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if num_slices is None:
+        num_slices = jax.process_count()
+    if devices is None:
+        devices = sorted(jax.devices(),
+                         key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    if n % num_slices:
+        raise ValueError(f"{n} devices not divisible into {num_slices} "
+                         f"slices")
+    per_slice = n // num_slices
+    inner_used = dp_per_slice * pp * ep * sp * tp
+    if per_slice % inner_used:
+        raise ValueError(
+            f"slice size {per_slice} not divisible by "
+            f"dp_per_slice*pp*ep*sp*tp={inner_used}")
+    resid = per_slice // inner_used
+    if fsdp is None:
+        fsdp = resid
+    elif fsdp != resid:
+        raise ValueError(f"fsdp={fsdp} but per-slice residual is {resid}")
+
+    spec = MeshSpec(dp=num_slices * dp_per_slice, fsdp=fsdp, pp=pp, ep=ep,
+                    sp=sp, tp=tp)
+    # Group process-major, shard the inner axes within each slice, then
+    # fold (slice, dp_per_slice) into the single global dp axis.
+    inner_shape = (dp_per_slice, fsdp, pp, ep, sp, tp)
+    arr = np.empty((num_slices,) + inner_shape, dtype=object)
+    for s in range(num_slices):
+        chunk = devices[s * per_slice:(s + 1) * per_slice]
+        arr[s] = np.asarray(chunk, dtype=object).reshape(inner_shape)
+    arr = arr.reshape((num_slices * dp_per_slice,) + inner_shape[1:])
+    return Mesh(arr, axis_names=AXIS_ORDER), spec
+
+
+def assert_slice_aligned(mesh, num_slices: Optional[int] = None) -> None:
+    """Verify no inner-axis neighborhood crosses a slice (process) boundary.
+
+    For each dp-outer index (slice), all devices in the sub-mesh must
+    report the same ``process_index`` — i.e. collectives on fsdp/pp/ep/
+    sp/tp ride ICI, and only dp traffic crosses DCN.  No-op for
+    single-process meshes (virtual slicing can't be checked there).
+    """
+    import jax
+
+    if num_slices is None:
+        num_slices = jax.process_count()
+    if num_slices <= 1:
+        return
+    dp = mesh.devices.shape[0]
+    if dp % num_slices:
+        raise AssertionError(
+            f"dp axis {dp} not divisible by num_slices {num_slices}")
+    per = dp // num_slices
+    for s in range(num_slices):
+        sub = mesh.devices[s * per:(s + 1) * per]
+        procs = {d.process_index for d in sub.flat}
+        if len(procs) != 1:
+            raise AssertionError(
+                f"slice {s} spans processes {sorted(procs)}: inner axes "
+                f"would put per-layer collectives on DCN")
+
+
+def dcn_axes() -> Tuple[str, ...]:
+    """Mesh axes whose collectives cross DCN in a slice_mesh layout."""
+    return ("dp",)
+
+
+def ici_axes() -> Tuple[str, ...]:
+    return tuple(a for a in AXIS_ORDER if a != "dp")

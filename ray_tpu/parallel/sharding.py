@@ -89,6 +89,22 @@ def with_logical_constraint(x, rules: LogicalAxisRules,
         x, rules.spec_for(logical_axes))
 
 
+def init_sharded(init_fn, mesh: Mesh, rules: LogicalAxisRules, annotations,
+                 *args):
+    """Multi-controller-safe sharded init.
+
+    ``device_put`` cannot span another process's devices, so on a
+    multi-host mesh params must be BORN sharded: run ``init_fn`` inside
+    ``jit`` with ``out_shardings`` derived from the logical annotations —
+    every process traces the same program and receives its addressable
+    shards of one global array per leaf.
+    """
+    shardings = jax.tree_util.tree_map(
+        lambda ann: logical_sharding(mesh, rules, ann), annotations,
+        is_leaf=lambda x: x is None or isinstance(x, tuple))
+    return jax.jit(init_fn, out_shardings=shardings)(*args)
+
+
 def shard_params(params, mesh: Mesh, rules: LogicalAxisRules, annotations):
     """Device-put a param pytree according to per-leaf logical annotations.
 

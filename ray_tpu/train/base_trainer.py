@@ -25,10 +25,16 @@ class BaseTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Any] = None):
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        # A string is treated as a storage URI (file://, gs://, ...) —
+        # reference base_trainer accepts Checkpoint objects whose storage
+        # may be remote; here the URI form is explicit.
+        if isinstance(resume_from_checkpoint, str):
+            resume_from_checkpoint = Checkpoint.from_uri(
+                resume_from_checkpoint)
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def setup(self) -> None:

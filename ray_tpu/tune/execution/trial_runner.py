@@ -69,7 +69,9 @@ class TrialRunner:
                  experiment_name: str = "exp",
                  storage_path: Optional[str] = None,
                  checkpoint_period: int = 10,
-                 reuse_actors: bool = False):
+                 reuse_actors: bool = False,
+                 sync_uri: Optional[str] = None,
+                 sync_period_s: float = 5.0):
         self._trainable_cls = trainable_cls
         self._trainable_blob = cloudpickle.dumps(trainable_cls)
         self._searcher = searcher
@@ -84,6 +86,12 @@ class TrialRunner:
         self._storage_path = storage_path
         self._checkpoint_period = checkpoint_period
         self._reuse_actors = reuse_actors
+        # Experiment-dir sync to URI storage (reference tune/syncer.py):
+        # every experiment-state save is mirrored to sync_uri, debounced to
+        # one upload per sync_period_s, with a forced final sync.
+        self._sync_uri = sync_uri
+        self._sync_period_s = sync_period_s
+        self._last_sync = 0.0
         self.trials: List[Trial] = []
         self._exploit_requests: List[Tuple[Trial, Trial, Dict]] = []
         self._searcher_exhausted = False
@@ -108,6 +116,7 @@ class TrialRunner:
         if self._storage_path and \
                 self._steps % self._checkpoint_period == 0:
             self.save_experiment_state()
+            self._maybe_sync()
 
     def is_finished(self) -> bool:
         return (self._searcher_exhausted
@@ -118,6 +127,22 @@ class TrialRunner:
             self.step()
         if self._storage_path:
             self.save_experiment_state()
+            self._maybe_sync(force=True)
+
+    def _maybe_sync(self, force: bool = False):
+        if not self._sync_uri or not self._storage_path:
+            return
+        now = time.time()
+        if not force and now - self._last_sync < self._sync_period_s:
+            return
+        self._last_sync = now
+        from ray_tpu.air.storage import get_provider
+        try:
+            get_provider(self._sync_uri).upload_dir(self._storage_path,
+                                                    self._sync_uri)
+        except Exception:
+            logger.warning("experiment sync to %s failed", self._sync_uri,
+                           exc_info=True)
 
     # -- internals --------------------------------------------------------
     def _maybe_add_trials(self):

@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Optional, Type, Union
 
 from ray_tpu.air.config import RunConfig
 from ray_tpu.air.result import Result
+from ray_tpu.air.storage import is_uri
 from ray_tpu.tune.execution.trial_runner import TrialRunner
 from ray_tpu.tune.experiment.trial import Trial
 from ray_tpu.tune.result_grid import ResultGrid
@@ -33,6 +34,24 @@ def _to_trainable_cls(trainable) -> Type[Trainable]:
     raise TypeError(f"cannot tune {type(trainable)}")
 
 
+def _mirror_dir(uri: str, fresh: bool = False) -> str:
+    """Local mirror for a synced experiment URI.
+
+    Keyed by (uri, pid) so concurrent same-URI runs on one machine don't
+    interleave writes; ``fresh=True`` wipes any leftover state first (a new
+    run must not inherit a previous experiment's files)."""
+    import hashlib
+    import shutil
+    import tempfile
+    h = hashlib.sha1(uri.encode()).hexdigest()[:12]
+    d = os.path.join(tempfile.gettempdir(),
+                     f"rt_tune_mirror_{h}_{os.getpid()}")
+    if fresh:
+        shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 class Tuner:
     def __init__(self,
                  trainable: Union[Callable, Type[Trainable], Any],
@@ -51,9 +70,11 @@ class Tuner:
     def restore(cls, path: str, trainable,
                 *, tune_config: Optional[TuneConfig] = None,
                 run_config: Optional[RunConfig] = None) -> "Tuner":
-        """Resume an interrupted experiment from its storage directory.
-        Pass the original tune_config/run_config so stop criteria and
-        schedulers apply to the resumed trials as well."""
+        """Resume an interrupted experiment from its storage directory or
+        URI (file://, gs://, ... — the experiment is downloaded first, so
+        no surviving node needs a local copy).  Pass the original
+        tune_config/run_config so stop criteria and schedulers apply to
+        the resumed trials as well."""
         return cls(trainable, tune_config=tune_config,
                    run_config=run_config, _restore_path=path)
 
@@ -74,11 +95,34 @@ class Tuner:
 
         name = self._run_config.name or "tune_experiment"
         storage = self._run_config.storage_path
+        restore_path = self._restore_path
+        sync_uri = None
         if storage:
-            storage = os.path.join(storage, name)
-        elif self._restore_path:
+            storage = (storage.rstrip("/") + "/" + name
+                       if is_uri(storage) else os.path.join(storage, name))
+        elif restore_path:
             # Resumed experiments keep checkpointing where they left off.
-            storage = self._restore_path
+            storage = restore_path
+        if storage and is_uri(storage):
+            # URI storage: run against a local mirror, sync every
+            # experiment-state save (reference tune/syncer.py).  A resume
+            # from a URI first pulls the experiment down — the local mirror
+            # may live on a node that never saw the original run.
+            sync_uri = storage
+            storage = _mirror_dir(sync_uri, fresh=True)
+            if restore_path:
+                from ray_tpu.air.storage import get_provider
+                get_provider(sync_uri).download_dir(sync_uri, storage)
+                restore_path = storage
+        elif restore_path and is_uri(restore_path):
+            # URI restore combined with local (or absent) storage_path:
+            # still download the experiment before reading state from it.
+            from ray_tpu.air.storage import get_provider
+            local = _mirror_dir(restore_path, fresh=True)
+            get_provider(restore_path).download_dir(restore_path, local)
+            restore_path = local
+            if not storage:
+                storage = local
 
         runner = TrialRunner(
             self._trainable_cls,
@@ -92,9 +136,10 @@ class Tuner:
             experiment_name=name,
             storage_path=storage,
             reuse_actors=tc.reuse_actors,
+            sync_uri=sync_uri,
         )
-        if self._restore_path:
-            runner.restore_experiment_state(self._restore_path)
+        if restore_path:
+            runner.restore_experiment_state(restore_path)
         runner.run_until_done()
         return ResultGrid(
             [self._trial_to_result(t) for t in runner.trials],

@@ -95,3 +95,46 @@ def test_shard_params_places_leaves():
     # w sharded (8/fsdp=2 rows, 16/tp=8 cols per device)
     shard_shape = out["w"].sharding.shard_shape(out["w"].shape)
     assert shard_shape == (2, 8)
+
+
+def test_slice_mesh_single_process_layout():
+    """slice_mesh on one process: axes fold correctly and fsdp auto-fills.
+
+    Virtual 'slices' partition the 8 CPU devices; with num_slices=2 the
+    dp axis must enumerate slices as its outer factor, so each dp row is
+    one contiguous device block (the would-be ICI domain)."""
+    from ray_tpu.parallel import slice_mesh
+
+    mesh, spec = slice_mesh(num_slices=2, tp=2)
+    assert spec.dp == 2 and spec.tp == 2 and spec.fsdp == 2
+    assert mesh.devices.shape == (2, 2, 1, 1, 1, 2)
+    devs = [d.id for d in jax.devices()]
+    row0 = sorted(d.id for d in mesh.devices[0].flat)
+    row1 = sorted(d.id for d in mesh.devices[1].flat)
+    assert row0 == devs[:4] and row1 == devs[4:]
+
+
+def test_slice_mesh_rejects_bad_factoring():
+    from ray_tpu.parallel import slice_mesh
+
+    with pytest.raises(ValueError):
+        slice_mesh(num_slices=3, tp=1)          # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        slice_mesh(num_slices=2, tp=2, fsdp=4)  # residual is 2, not 4
+
+
+def test_init_sharded_matches_shard_params():
+    from ray_tpu.parallel import init_sharded, shard_params
+
+    mesh = MeshSpec(tp=2, fsdp=4).build()
+    rules = LogicalAxisRules.for_transformer()
+    ann = {"w": ("embed", "mlp"), "b": ("mlp",)}
+
+    def init():
+        return {"w": jnp.ones((8, 16)), "b": jnp.ones((16,))}
+
+    a = init_sharded(init, mesh, rules, ann)
+    b = shard_params(init(), mesh, rules, ann)
+    assert a["w"].sharding == b["w"].sharding
+    assert a["w"].sharding.shard_shape(a["w"].shape) == (2, 8)
+    np.testing.assert_allclose(a["w"], b["w"])
