@@ -119,3 +119,8 @@ def timeline(filename=None):
     """Chrome-trace JSON of task executions (reference: `ray timeline`)."""
     from ray_tpu.util.state import timeline as _tl
     return _tl(filename)
+
+
+# ray_tpu.util is part of the public surface (reference: `ray.util` is
+# importable off the bare `import ray`); imported last to avoid cycles.
+from ray_tpu import util  # noqa: E402,F401

@@ -28,6 +28,7 @@ class RtConfig:
     # -- object plumbing --
     inline_max_bytes: int = 100 * 1024      # owner-inline object ceiling
     transfer_chunk_bytes: int = 4 * 1024 * 1024  # node-to-node pull frames
+    push_inflight_chunks: int = 4           # per-link push pipelining cap
     # -- control plane --
     heartbeat_period_s: float = 0.5
     health_timeout_s: float = 15.0          # missed-heartbeat death window

@@ -672,6 +672,36 @@ class CoreWorker:
         except ConnectionLost:
             return False
 
+    def broadcast_object(self, ref, timeout: float = 300) -> int:
+        """Proactively replicate a plasma object to every alive node via
+        the raylet's binomial-tree push (reference push_manager.h has the
+        push half; the tree fan-out is new — a 1->N broadcast does O(log N)
+        rounds instead of N pulls hammering the owner).  Returns the number
+        of target nodes.  Small (inline) objects are a no-op."""
+        oid_hex = ref.id.hex()
+        entry = self.memory_store.get(oid_hex)
+        # "cval" is a client-mode byte cache over a real plasma object —
+        # only true inline values ("val") skip replication.
+        if entry is not None and entry[0] not in ("plasma", "cval"):
+            return 0  # inline value: every consumer gets it with the ref
+        if self.raylet is None:
+            raise RuntimeError("broadcast requires a local raylet")
+
+        async def _bcast():
+            nodes = await self.gcs.request({"type": "get_nodes"})
+            targets = [n["address"] for n in nodes
+                       if n["alive"] and n["node_id"] != self.node_id_hex]
+            if not targets:
+                return 0
+            r = await self.raylet.request(
+                {"type": "broadcast_object", "object_id": oid_hex,
+                 "targets": targets, "timeout": timeout}, timeout=timeout)
+            if not r.get("ok"):
+                raise RuntimeError(f"broadcast failed: {r.get('error')}")
+            return len(targets)
+
+        return self._run(_bcast(), timeout=timeout + 10)
+
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = False):
         ready, not_ready = self._run(

@@ -43,3 +43,41 @@ async def fetch_object_into(conn, oid_hex: str,
         buf[pos:pos + len(d)] = d
         pos += len(d)
     return buf
+
+
+async def push_object_chunks(peer, oid_hex: str, view, total: int,
+                             chunk_bytes: int, inflight: int,
+                             timeout: float = 120) -> bool:
+    """Owner/holder-initiated chunked push (reference push_manager.h:29).
+
+    Pipelines up to ``inflight`` chunk requests per link — the cap is the
+    bandwidth-admission knob: one bulk push can't bury a peer's IO loop,
+    and N concurrent pushes to one node self-throttle at N*inflight
+    chunks.  Returns True when the receiver acked every chunk (or already
+    had the object).
+    """
+    import asyncio
+
+    sem = asyncio.Semaphore(inflight)
+
+    async def _send(off: int):
+        async with sem:
+            # Slice INSIDE the cap: at most `inflight` chunk copies exist
+            # at once, so sender heap stays O(inflight * chunk), not O(obj).
+            data = bytes(view[off:min(off + chunk_bytes, total)])
+            return await peer.request(
+                {"type": "receive_object_chunk", "object_id": oid_hex,
+                 "offset": off, "total": total, "data": data},
+                timeout=timeout)
+
+    replies = await asyncio.gather(
+        *(_send(off) for off in range(0, max(total, 1), chunk_bytes)),
+        return_exceptions=True)
+    ok = True
+    for r in replies:
+        if isinstance(r, BaseException):
+            raise r
+        if r.get("done"):          # receiver already complete/had it
+            return True
+        ok = ok and r.get("ok", False)
+    return ok

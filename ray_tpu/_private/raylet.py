@@ -102,6 +102,8 @@ class Raylet:
         # pg bundle pools: (pg_id, bundle_index) -> available resources
         self.bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self._peer_conns: Dict[str, RpcConnection] = {}
+        # In-flight pushed-object assemblies: oid hex -> buffer state.
+        self._incoming: Dict[str, dict] = {}
         self._tasks: List[asyncio.Task] = []
         self._shutdown = False
         # Object spilling (reference raylet/local_object_manager.h:41).
@@ -219,11 +221,23 @@ class Raylet:
 
     async def _reap_loop(self):
         """Detect dead worker processes (reference: WorkerPool +
-        NodeManager::HandleUnexpectedWorkerFailure)."""
+        NodeManager::HandleUnexpectedWorkerFailure) and sweep stale
+        half-received pushes (a pusher dying mid-stream must not pin an
+        unsealed, unevictable plasma allocation forever)."""
         while not self._shutdown:
             for w in list(self.workers.values()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)
+            now = time.monotonic()
+            for k, st in list(self._incoming.items()):
+                if now - st["t"] > 120 and st.get("buf") is not None:
+                    self._incoming.pop(k, None)
+                    try:
+                        self.plasma.release(ObjectID.from_hex(k))
+                        self.plasma.delete(ObjectID.from_hex(k))
+                    except Exception:
+                        logger.debug("stale push reap failed for %s", k[:16],
+                                     exc_info=True)
             await asyncio.sleep(0.2)
 
     async def _on_worker_death(self, w: WorkerHandle):
@@ -964,6 +978,120 @@ class Raylet:
                                      "object_id": msg["object_id"],
                                      "node_id": self.node_id.hex()})
         return {"ok": True}
+
+    # -- push-based transfer (reference object_manager/push_manager.h:29) --
+
+    async def _h_push_object(self, conn, msg):
+        """Push a locally-held object's chunks to one target node, with a
+        per-link in-flight cap (owner-initiated transfer: the receiver
+        never has to discover or poll the holder)."""
+        ok = await self._push_to(msg["target"], msg["object_id"],
+                                 timeout=msg.get("timeout", 120))
+        return {"ok": ok}
+
+    async def _push_to(self, target_addr: str, oid_hex: str,
+                       timeout: float = 120) -> bool:
+        from ray_tpu._private.object_transfer import push_object_chunks
+        oid = ObjectID.from_hex(oid_hex)
+        view = self.plasma.get(oid)
+        if view is None:
+            return False
+        try:
+            peer = await self._peer(target_addr)
+            return await push_object_chunks(
+                peer, oid_hex, view, len(view), TRANSFER_CHUNK(),
+                config().push_inflight_chunks, timeout=timeout)
+        finally:
+            view.release()
+            self.plasma.release(oid)
+
+    async def _h_receive_object_chunk(self, conn, msg):
+        """Assemble pushed chunks into plasma; seal + publish location on
+        completion.  Chunks may interleave across pushers — offsets are
+        tracked as a set so a duplicate push can't fake completion."""
+        oid_hex = msg["object_id"]
+        oid = ObjectID.from_hex(oid_hex)
+        if self.plasma.contains(oid):
+            return {"ok": True, "done": True}
+        now = time.monotonic()
+        st = self._incoming.get(oid_hex)
+        if st is None:
+            # Claim the assembly slot SYNCHRONOUSLY before the (possibly
+            # spilling, hence awaiting) plasma create — a concurrent chunk
+            # of the same push must wait on `ready`, not double-create.
+            st = {"buf": None, "total": msg["total"], "offsets": set(),
+                  "received": 0, "t": now, "ready": asyncio.Event(),
+                  "error": None}
+            self._incoming[oid_hex] = st
+            try:
+                st["buf"] = await self._create_with_spill(oid, msg["total"])
+            except Exception as e:
+                st["error"] = e
+                self._incoming.pop(oid_hex, None)
+                raise
+            finally:
+                st["ready"].set()
+        elif st["buf"] is None:
+            await st["ready"].wait()
+            if st["error"] is not None:
+                raise RuntimeError(f"buffer create failed: {st['error']}")
+        st["t"] = now
+        off = msg["offset"]
+        data = msg["data"]
+        if off not in st["offsets"]:
+            st["buf"][off:off + len(data)] = data
+            st["offsets"].add(off)
+            st["received"] += len(data)
+        if st["received"] >= st["total"]:
+            self._incoming.pop(oid_hex, None)
+            self.plasma.seal(oid)
+            self.plasma.release(oid)
+            await self.gcs_conn.request({"type": "object_location_add",
+                                         "object_id": oid_hex,
+                                         "node_id": self.node_id.hex()})
+            return {"ok": True, "done": True}
+        return {"ok": True}
+
+    async def _h_broadcast_object(self, conn, msg):
+        """Binomial-tree 1->N broadcast: push to the head of each half of
+        the target list and delegate that half's remainder to it.  O(log N)
+        rounds, each link carries the object exactly once — vs. the pull
+        storm where all N nodes hammer the single holder (reference has no
+        broadcast; its pull manager merely dedups concurrent pulls)."""
+        oid_hex = msg["object_id"]
+        oid = ObjectID.from_hex(oid_hex)
+        # The caller's deadline governs the whole subtree: relay hops and
+        # per-chunk requests inherit it rather than hardcoded defaults.
+        timeout = msg.get("timeout", 300)
+        if not self.plasma.contains(oid):
+            r = await self._h_pull_object(conn, {"object_id": oid_hex})
+            if not r.get("ok"):
+                return {"ok": False,
+                        "error": f"relay lacks object: {r.get('error')}"}
+
+        async def _relay(head: str, sub: list):
+            if not await self._push_to(head, oid_hex, timeout=timeout):
+                raise RuntimeError(f"push to {head} failed")
+            if sub:
+                peer = await self._peer(head)
+                r = await peer.request({"type": "broadcast_object",
+                                        "object_id": oid_hex,
+                                        "targets": sub,
+                                        "timeout": timeout},
+                                       timeout=timeout)
+                if not r.get("ok"):
+                    raise RuntimeError(
+                        f"relay at {head} failed: {r.get('error')}")
+
+        targets = list(msg.get("targets") or [])
+        tasks = []
+        while targets:
+            mid = (len(targets) + 1) // 2
+            head, sub, targets = targets[0], targets[1:mid], targets[mid:]
+            tasks.append(_relay(head, sub))
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        errs = [str(r) for r in results if isinstance(r, BaseException)]
+        return {"ok": not errs, "error": "; ".join(errs[:3]) or None}
 
     async def _peer(self, addr: str) -> RpcConnection:
         conn = self._peer_conns.get(addr)
