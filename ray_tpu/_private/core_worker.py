@@ -166,6 +166,13 @@ class CoreWorker:
         await self.server.close()
         for c in list(self._worker_conns.values()):
             await c.close()
+        # Actor-handle connections are dialed lazily per actor; close them
+        # too or their _serve tasks outlive the loop ("Task was destroyed
+        # but it is pending" spam at every interpreter exit).
+        for st in list(self.actor_state.values()):
+            conn = st.get("conn")
+            if conn is not None and not conn.closed:
+                await conn.close()
         if self.raylet:
             await self.raylet.close()
         await self.gcs.close()
@@ -848,6 +855,20 @@ class CoreWorker:
             return asyncio.ensure_future(coro, loop=self.loop)
         return self._run(coro)
 
+    def resolve_args_fast(self, args_entries, kwargs_entries):
+        """Synchronous fast path: when no entry is an object ref, resolve
+        without the async machinery (no gather, no wait_for task/timer) —
+        the common case for small actor calls, and a measurable win on the
+        calls/s hot path.  Returns None when an async fetch is needed."""
+        if any(e[0] != "v" for e in args_entries) or \
+                any(e[0] != "v" for e in kwargs_entries.values()):
+            return None
+        args = [self.ser.deserialize(memoryview(e[1]))
+                for e in args_entries]
+        kwargs = {k: self.ser.deserialize(memoryview(e[1]))
+                  for k, e in kwargs_entries.items()}
+        return args, kwargs
+
     async def resolve_args(self, args_entries, kwargs_entries):
         async def one(entry):
             kind = entry[0]
@@ -1231,9 +1252,13 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": self.address,
         }
-        asyncio.run_coroutine_threadsafe(
-            self._submit_actor_call(actor_id_hex, call, return_ids,
-                                    pinned_args=pinned_args), self.loop)
+        # Fire-and-forget hand-off: call_soon_threadsafe + ensure_future is
+        # ~2x cheaper per call than run_coroutine_threadsafe (no
+        # concurrent.futures.Future or chain callback), and nothing reads
+        # the submission's result here — outcomes land in the memory store.
+        coro = self._submit_actor_call(actor_id_hex, call, return_ids,
+                                       pinned_args=pinned_args)
+        self.loop.call_soon_threadsafe(asyncio.ensure_future, coro)
         return refs
 
     async def _submit_actor_call(self, actor_id_hex, call, return_ids,
@@ -1302,6 +1327,12 @@ class CoreWorker:
                 self._store_local(oid.hex(), "err", payload)
 
     async def _actor_conn(self, actor_id_hex: str, st: dict) -> RpcConnection:
+        # Lock-free fast path: the connection exists for every call after
+        # the first, and the IO loop is single-threaded, so a plain read is
+        # safe — the lock only guards concurrent dials below.
+        conn = st["conn"]
+        if conn is not None and not conn.closed:
+            return conn
         async with st["lock"]:
             if st["conn"] is not None and not st["conn"].closed:
                 return st["conn"]

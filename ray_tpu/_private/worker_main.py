@@ -69,9 +69,14 @@ class TaskExecutor:
             fn = await self.core.load_function(spec["fid"])
             from ray_tpu._private.config import config as _rt_config
             try:
-                args, kwargs = await asyncio.wait_for(
-                    self.core.resolve_args(spec["args"], spec["kwargs"]),
-                    timeout=_rt_config().arg_resolution_timeout_s)
+                fast = self.core.resolve_args_fast(spec["args"],
+                                                   spec["kwargs"])
+                if fast is not None:
+                    args, kwargs = fast
+                else:
+                    args, kwargs = await asyncio.wait_for(
+                        self.core.resolve_args(spec["args"], spec["kwargs"]),
+                        timeout=_rt_config().arg_resolution_timeout_s)
             except asyncio.TimeoutError:
                 # Retriable: give the lease back so reconstruction (or
                 # whatever produces the arg) can get a worker; the
@@ -163,7 +168,7 @@ class TaskExecutor:
         key = id(conn)
         order = self._order.get(key)
         if order is None:
-            order = self._order[key] = {"next": 0, "cond": asyncio.Condition()}
+            order = self._order[key] = {"next": 0, "waiters": {}}
         seq = msg.get("seq", 0)
         if self._exit_requested:
             from ray_tpu.exceptions import ActorDiedError
@@ -172,32 +177,38 @@ class TaskExecutor:
         t0 = time.time()
         status = "FINISHED"
         try:
-            async with order["cond"]:
-                await order["cond"].wait_for(lambda: order["next"] >= seq)
+            if order["next"] < seq:
+                fut = asyncio.get_running_loop().create_future()
+                order["waiters"].setdefault(seq, []).append(fut)
+                await fut
             method = getattr(self.actor_instance, msg["method"])
-            from ray_tpu._private.config import config as _rt_config
-            try:
-                args, kwargs = await asyncio.wait_for(
-                    self.core.resolve_args(msg["args"], msg["kwargs"]),
-                    timeout=_rt_config().arg_resolution_timeout_s)
-            except asyncio.TimeoutError:
-                # Retriable: the caller resends with a fresh seq; advance
-                # the order cursor so later calls aren't blocked behind
-                # this one.
-                status = "FAILED"
-                await self._advance(order, seq)
-                return {"ok": False, "retriable": True,
-                        "error": _serialize_exception(RuntimeError(
-                            "actor-call argument resolution timed out"))}
+            fast = self.core.resolve_args_fast(msg["args"], msg["kwargs"])
+            if fast is not None:
+                args, kwargs = fast
+            else:
+                from ray_tpu._private.config import config as _rt_config
+                try:
+                    args, kwargs = await asyncio.wait_for(
+                        self.core.resolve_args(msg["args"], msg["kwargs"]),
+                        timeout=_rt_config().arg_resolution_timeout_s)
+                except asyncio.TimeoutError:
+                    # Retriable: the caller resends with a fresh seq;
+                    # advance the order cursor so later calls aren't
+                    # blocked behind this one.
+                    status = "FAILED"
+                    self._advance(order, seq)
+                    return {"ok": False, "retriable": True,
+                            "error": _serialize_exception(RuntimeError(
+                                "actor-call argument resolution timed out"))}
             if inspect.iscoroutinefunction(method):
                 async with self._sem:
-                    await self._advance(order, seq)
+                    self._advance(order, seq)
                     result = await method(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
                 fut = loop.run_in_executor(
                     self.core.exec_pool, lambda: method(*args, **kwargs))
-                await self._advance(order, seq)
+                self._advance(order, seq)
                 result = await fut
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],
                     "call_id": msg["call_id"]}
@@ -216,7 +227,7 @@ class TaskExecutor:
                 ActorDiedError("actor exited via exit_actor()"))}
         except Exception as e:  # noqa: BLE001
             status = "FAILED"
-            await self._advance(order, seq)
+            self._advance(order, seq)
             return {"ok": False, "error": _serialize_exception(e)}
         finally:
             self.core.record_task_event({
@@ -225,11 +236,17 @@ class TaskExecutor:
                 "start": t0, "end": time.time(), "status": status})
 
     @staticmethod
-    async def _advance(order: dict, seq: int):
-        async with order["cond"]:
-            if order["next"] <= seq:
-                order["next"] = seq + 1
-            order["cond"].notify_all()
+    def _advance(order: dict, seq: int):
+        # Single-threaded on the IO loop, so plain bookkeeping suffices —
+        # the previous asyncio.Condition cost two lock suspensions per
+        # call even with nothing waiting (the hot path).
+        if order["next"] <= seq:
+            order["next"] = seq + 1
+        nxt = order["next"]
+        for s in [s for s in order["waiters"] if s <= nxt]:
+            for f in order["waiters"].pop(s):
+                if not f.done():
+                    f.set_result(None)
 
     async def _report_intended_exit(self):
         self._exit_requested = True

@@ -82,9 +82,13 @@ def _controller() -> "ray_tpu.actor.ActorHandle":
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         actor_cls = ray_tpu.remote(ServeController)
+        # Generous concurrency: every live DeploymentHandle keeps one
+        # listen_for_change long-poll PARKED in a slot (reference
+        # LongPollHost is slot-free only because Serve's controller is
+        # asyncio-unbounded); parked polls cost memory, not CPU.
         return actor_cls.options(name=CONTROLLER_NAME, lifetime="detached",
                                  get_if_exists=True, num_cpus=0.1,
-                                 max_concurrency=64).remote()
+                                 max_concurrency=512).remote()
 
 
 def run(target: Deployment, *, _blocking: bool = True) -> DeploymentHandle:

@@ -102,6 +102,33 @@ class ServeController:
         # lock a concurrent `reps[:] = alive` clobbers (and orphans)
         # replicas the other invocation just created.
         self._reconcile_lock = asyncio.Lock()
+        # Long-poll state (reference serve/_private/long_poll.py
+        # LongPollHost): per-deployment replica-set version + waiter event.
+        self._versions: Dict[str, int] = {}
+        self._change_events: Dict[str, asyncio.Event] = {}
+
+    def _bump_version(self, name: str):
+        self._versions[name] = self._versions.get(name, 0) + 1
+        ev = self._change_events.pop(name, None)
+        if ev is not None:
+            ev.set()
+
+    async def listen_for_change(self, name: str, last_version: int,
+                                timeout: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: parks until the deployment's replica set differs from
+        ``last_version`` (or timeout), then returns the current snapshot.
+        Routers learn about scale events push-style instead of waiting out
+        a TTL (reference long_poll.py:listen_for_change)."""
+        cur = self._versions.get(name, 0)
+        if cur == last_version:
+            ev = self._change_events.setdefault(name, asyncio.Event())
+            try:
+                await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            cur = self._versions.get(name, 0)
+        return {"version": cur,
+                "replicas": list(self.replicas.get(name, []))}
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -157,6 +184,7 @@ class ServeController:
             self.targets.pop(name, None)
             for r in self.replicas.pop(name, []):
                 await self._kill_replica(r)
+            self._bump_version(name)
         return True
 
     async def status(self) -> Dict[str, Any]:
@@ -211,6 +239,7 @@ class ServeController:
         async with self._reconcile_lock:
             for name, spec in list(self.deployments.items()):
                 reps = self.replicas.setdefault(name, [])
+                before = [r._actor_id for r in reps]
                 target = self.targets.get(name, spec.num_replicas)
                 # Probe health in parallel; kill-and-replace failures (a
                 # merely dropped replica would keep running and leak its
@@ -239,6 +268,8 @@ class ServeController:
                     reps.append(ActorHandle(actor_id, "Replica"))
                 while len(reps) > target:
                     await self._kill_replica(reps.pop())
+                if [r._actor_id for r in reps] != before:
+                    self._bump_version(name)
 
     async def _autoscale(self):
         """Queue-depth autoscaling (reference: autoscaling_policy.py:93)."""

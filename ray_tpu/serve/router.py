@@ -1,9 +1,13 @@
-"""Client-side router: queue-aware replica choice.
+"""Client-side router: queue-aware replica choice, push-updated.
 
 Reference analog: Router/ReplicaSet (_private/router.py:261,62) — requests
 are assigned client-side to the replica with the fewest locally-tracked
-outstanding requests among two random candidates (power-of-two-choices),
-with the replica set cached and refreshed from the controller.
+outstanding requests among two random candidates (power-of-two-choices).
+The replica set is kept fresh by a long-poll listener thread against the
+controller (reference serve/_private/long_poll.py LongPollClient): scale
+events become visible push-style, typically within one RPC round-trip.
+The TTL refresh remains only as a safety net (listener thread died, or
+the controller was replaced).
 """
 
 from __future__ import annotations
@@ -11,11 +15,17 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Any, Dict, List
 
 import ray_tpu
 
-REFRESH_PERIOD_S = 1.0
+# Fallback only — the long-poll listener delivers changes immediately.
+REFRESH_PERIOD_S = 30.0
+# In-flight counters are a within-window heuristic; they must keep the old
+# 1s reset cadence now that refreshes are rare.
+COUNTER_RESET_PERIOD_S = 1.0
+_LISTEN_TIMEOUT_S = 30.0
 
 
 class DeploymentHandle:
@@ -28,6 +38,9 @@ class DeploymentHandle:
         self._outstanding: Dict[str, int] = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+        self._version = 0
+        self._listener: threading.Thread = None
+        self._counters_reset_at = 0.0
 
     def __reduce__(self):
         # Handles travel into replicas for deployment graphs (a deployment
@@ -36,6 +49,7 @@ class DeploymentHandle:
         return (DeploymentHandle, (self._name, self._controller))
 
     def _refresh(self, force: bool = False):
+        self._ensure_listener()
         now = time.monotonic()
         if not force and now - self._last_refresh < REFRESH_PERIOD_S:
             return
@@ -48,6 +62,18 @@ class DeploymentHandle:
             # the power-of-two choice within the window, and resetting
             # makes lost decrements self-healing.
             self._outstanding = {}
+
+    def _ensure_listener(self):
+        with self._lock:
+            t = self._listener
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=_listen_loop,
+                                 args=(weakref.ref(self),),
+                                 name=f"serve-longpoll-{self._name}",
+                                 daemon=True)
+            self._listener = t
+        t.start()
 
     def _pick(self):
         with self._lock:
@@ -67,10 +93,15 @@ class DeploymentHandle:
         self._refresh()
         replica = self._pick()
         aid = replica._actor_id
+        now = time.monotonic()
         with self._lock:
-            # In-flight estimate; reset wholesale on each refresh rather
+            # In-flight estimate; reset wholesale on a short cadence rather
             # than tracking completions (which would cost a deserialization
-            # per reply just to decrement a heuristic counter).
+            # per reply just to decrement a heuristic counter).  Decoupled
+            # from the refresh TTL: with push updates, refreshes are rare.
+            if now - self._counters_reset_at > COUNTER_RESET_PERIOD_S:
+                self._outstanding = {}
+                self._counters_reset_at = now
             self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
         return replica.handle_request.remote(list(args), kwargs, _method)
 
@@ -82,3 +113,35 @@ class DeploymentHandle:
                 return h.remote(*a, _method=name, **k)
         return _M()
 
+
+
+def _listen_loop(handle_ref):
+    """Long-poll listener: parks on controller.listen_for_change and applies
+    replica-set updates the moment they land.  Holds only a weakref to the
+    handle so a dropped handle lets both the handle and this thread die."""
+    while True:
+        h = handle_ref()
+        if h is None:
+            return
+        if h._listener is not threading.current_thread():
+            return  # superseded by a newer listener
+        name, controller, ver = h._name, h._controller, h._version
+        del h
+        try:
+            res = ray_tpu.get(
+                controller.listen_for_change.remote(
+                    name, ver, _LISTEN_TIMEOUT_S),
+                timeout=_LISTEN_TIMEOUT_S + 30)
+        except Exception:
+            time.sleep(1.0)
+            continue
+        h = handle_ref()
+        if h is None:
+            return
+        if res["version"] != ver:
+            with h._lock:
+                h._replicas = res["replicas"]
+                h._version = res["version"]
+                h._outstanding = {}
+                h._last_refresh = time.monotonic()
+        del h
