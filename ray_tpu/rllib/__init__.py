@@ -14,7 +14,11 @@ from ray_tpu.rllib.policy import Policy, PPOPolicy, compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
+from ray_tpu.rllib.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
+                                       MultiAgentEnv, MultiAgentPPO,
+                                       MultiAgentPPOConfig)
 from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 

@@ -1,0 +1,269 @@
+"""SAC: soft actor-critic for continuous control.
+
+Design analog: reference ``rllib/algorithms/sac/sac.py`` +
+``sac_torch_policy.py`` (squashed-Gaussian actor, twin soft Q critics,
+auto-tuned entropy temperature, polyak-averaged targets).  TPU-first: the
+entire update — actor, both critics, alpha, and the polyak target move —
+is ONE jitted program; action sampling is a second jitted function driven
+by an explicit PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import (ACTIONS, DONES, NEXT_OBS, OBS,
+                                        REWARDS, SampleBatch)
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self._config.update({
+            "policy": "sac",
+            "hiddens": (64, 64),
+            "actor_lr": 3e-4,
+            "critic_lr": 3e-4,
+            "alpha_lr": 3e-4,
+            "initial_alpha": 0.1,
+            "tau": 0.005,                    # polyak rate
+            "train_batch_size": 256,
+            "buffer_size": 100_000,
+            "learning_starts": 1500,
+            "num_train_iters": 8,
+            "rollout_fragment_length": 8,
+            "num_envs_per_worker": 8,
+            "gamma": 0.99,
+        })
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        lim = 1.0 / np.sqrt(sizes[i])
+        params.append({
+            "w": jax.random.uniform(k, (sizes[i], sizes[i + 1]),
+                                    minval=-lim, maxval=lim),
+            "b": jnp.zeros((sizes[i + 1],))})
+    return params
+
+
+def _mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def _actor_out(actor, obs, act_dim):
+    out = _mlp(actor, obs)
+    mu, log_std = out[:, :act_dim], out[:, act_dim:]
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    return mu, log_std
+
+
+def _sample_action(actor, obs, key, act_dim, scale):
+    """Squashed-Gaussian sample + its log prob (with tanh correction)."""
+    mu, log_std = _actor_out(actor, obs, act_dim)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    # log N(pre; mu, std) - sum log(1 - tanh^2) (change of variables);
+    # the numerically-stable tanh-correction form from the SAC paper.
+    logp = jnp.sum(
+        -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - 2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)),
+        axis=-1)
+    return a * scale, logp
+
+
+def _q_forward(critic, obs, act):
+    return _mlp(critic, jnp.concatenate([obs, act], axis=-1))[:, 0]
+
+
+class SACPolicy(Policy):
+    replay_style = True
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        if action_space.kind != "box":
+            raise ValueError("SAC requires a continuous (box) action space")
+        self.config = config
+        act_dim = int(np.prod(action_space.shape)) or 1
+        self.act_dim = act_dim
+        self.act_scale = float(action_space.high)
+        hid = tuple(config.get("hiddens", (64, 64)))
+        key = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(key, 3)
+        actor = _mlp_init(ka, (obs_dim,) + hid + (2 * act_dim,))
+        q1 = _mlp_init(k1, (obs_dim + act_dim,) + hid + (1,))
+        q2 = _mlp_init(k2, (obs_dim + act_dim,) + hid + (1,))
+        log_alpha = jnp.log(jnp.asarray(config.get("initial_alpha", 0.1)))
+        self.params = {"actor": actor, "q1": q1, "q2": q2,
+                       "log_alpha": log_alpha}
+        self.target = {"q1": jax.tree.map(jnp.copy, q1),
+                       "q2": jax.tree.map(jnp.copy, q2)}
+
+        import optax
+        self._tx = {
+            "actor": optax.adam(config.get("actor_lr", 3e-4)),
+            "critic": optax.adam(config.get("critic_lr", 3e-4)),
+            "alpha": optax.adam(config.get("alpha_lr", 3e-4)),
+        }
+        self.opt_state = {
+            "actor": self._tx["actor"].init(actor),
+            "critic": self._tx["critic"].init({"q1": q1, "q2": q2}),
+            "alpha": self._tx["alpha"].init(log_alpha),
+        }
+        self._key = jax.random.PRNGKey(seed + 7)
+        gamma = config.get("gamma", 0.99)
+        tau = config.get("tau", 0.005)
+        scale = self.act_scale
+        target_entropy = -float(act_dim)
+
+        @jax.jit
+        def _act(actor, obs, key, deterministic):
+            mu, _ = _actor_out(actor, obs, act_dim)
+            a, _ = _sample_action(actor, obs, key, act_dim, scale)
+            return jnp.where(deterministic, jnp.tanh(mu) * scale, a)
+
+        self._act = _act
+
+        @jax.jit
+        def _update(params, target, opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            # -- critic update (soft Bellman backup on twin mins)
+            a_next, logp_next = _sample_action(
+                params["actor"], batch[NEXT_OBS], k1, act_dim, scale)
+            qn = jnp.minimum(
+                _q_forward(target["q1"], batch[NEXT_OBS], a_next),
+                _q_forward(target["q2"], batch[NEXT_OBS], a_next))
+            backup = batch[REWARDS] + gamma * (
+                1.0 - batch[DONES].astype(jnp.float32)) * (
+                qn - alpha * logp_next)
+            backup = jax.lax.stop_gradient(backup)
+
+            def critic_loss(qs):
+                l1 = jnp.mean((_q_forward(qs["q1"], batch[OBS],
+                                          batch[ACTIONS]) - backup) ** 2)
+                l2 = jnp.mean((_q_forward(qs["q2"], batch[OBS],
+                                          batch[ACTIONS]) - backup) ** 2)
+                return l1 + l2
+
+            qs = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(qs)
+            cupd, opt_c = self._tx["critic"].update(
+                cgrads, opt_state["critic"])
+            import optax as _ox
+            qs = _ox.apply_updates(qs, cupd)
+
+            # -- actor update (against the UPDATED critics)
+            def actor_loss(actor):
+                a, logp = _sample_action(actor, batch[OBS], k2, act_dim,
+                                         scale)
+                q = jnp.minimum(_q_forward(qs["q1"], batch[OBS], a),
+                                _q_forward(qs["q2"], batch[OBS], a))
+                return jnp.mean(alpha * logp - q), logp
+
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params["actor"])
+            aupd, opt_a = self._tx["actor"].update(
+                agrads, opt_state["actor"])
+            actor = _ox.apply_updates(params["actor"], aupd)
+
+            # -- temperature update (match target entropy)
+            def alpha_loss(log_alpha):
+                return -jnp.mean(jnp.exp(log_alpha) * jax.lax.stop_gradient(
+                    logp + target_entropy))
+
+            lloss, lgrad = jax.value_and_grad(alpha_loss)(
+                params["log_alpha"])
+            lupd, opt_l = self._tx["alpha"].update(
+                lgrad, opt_state["alpha"])
+            log_alpha = _ox.apply_updates(params["log_alpha"], lupd)
+
+            # -- polyak target move
+            target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  target, qs)
+            params = {"actor": actor, "q1": qs["q1"], "q2": qs["q2"],
+                      "log_alpha": log_alpha}
+            opt_state = {"actor": opt_a, "critic": opt_c, "alpha": opt_l}
+            stats = {"critic_loss": closs, "actor_loss": aloss,
+                     "alpha": jnp.exp(log_alpha),
+                     "entropy": -jnp.mean(logp)}
+            return params, target, opt_state, stats
+
+        self._update = _update
+
+    # -- rollout side -----------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._key, k = jax.random.split(self._key)
+        a = self._act(self.params["actor"],
+                      jnp.asarray(obs, jnp.float32), k, False)
+        return {ACTIONS: np.asarray(a, np.float32)}
+
+    # -- learner side -----------------------------------------------------
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        device_batch = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            NEXT_OBS: jnp.asarray(np.asarray(batch[NEXT_OBS], np.float32)),
+            ACTIONS: jnp.asarray(
+                np.asarray(batch[ACTIONS], np.float32).reshape(
+                    batch.count, self.act_dim)),
+            REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+            DONES: jnp.asarray(np.asarray(batch[DONES])),
+        }
+        self._key, k = jax.random.split(self._key)
+        self.params, self.target, self.opt_state, stats = self._update(
+            self.params, self.target, self.opt_state, device_batch, k)
+        return {k2: float(v) for k2, v in stats.items()}
+
+    def update_target(self):
+        pass  # polyak-averaged inside every update
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class SAC(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "sac")
+        super().setup(config)
+        self.replay = ReplayBuffer(config.get("buffer_size", 100_000),
+                                   seed=config.get("seed", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        batch = self.workers.synchronous_sample()
+        self._timesteps_total += batch.count
+        self.replay.add(batch)
+        stats: Dict[str, Any] = {}
+        policy = self.workers.local_worker.policy
+        if len(self.replay) >= c.get("learning_starts", 1500):
+            for _ in range(c.get("num_train_iters", 8)):
+                train = self.replay.sample(c.get("train_batch_size", 256))
+                stats = policy.learn_on_batch(train)
+            self.workers.sync_weights()
+        # Same result schema as DQN/IMPALA/MultiAgentPPO: learner stats
+        # nest under info.learner (flat copies kept for convenience).
+        return {"info": {"learner": stats}, **stats}

@@ -64,6 +64,12 @@ class DeploymentHandle:
             self._outstanding = {}
 
     def _ensure_listener(self):
+        # Unlocked pre-check keeps the steady-state remote() path to one
+        # lock acquisition; the locked re-check below handles the benign
+        # startup race.
+        t = self._listener
+        if t is not None and t.is_alive():
+            return
         with self._lock:
             t = self._listener
             if t is not None and t.is_alive():
@@ -118,7 +124,11 @@ class DeploymentHandle:
 def _listen_loop(handle_ref):
     """Long-poll listener: parks on controller.listen_for_change and applies
     replica-set updates the moment they land.  Holds only a weakref to the
-    handle so a dropped handle lets both the handle and this thread die."""
+    handle so a dropped handle lets both the handle and this thread die.
+    Backs off exponentially on failure and exits after ~10 consecutive
+    errors (controller gone, e.g. serve.shutdown with live handles) — a
+    later remote() restarts it via _ensure_listener."""
+    failures = 0
     while True:
         h = handle_ref()
         if h is None:
@@ -132,8 +142,12 @@ def _listen_loop(handle_ref):
                 controller.listen_for_change.remote(
                     name, ver, _LISTEN_TIMEOUT_S),
                 timeout=_LISTEN_TIMEOUT_S + 30)
+            failures = 0
         except Exception:
-            time.sleep(1.0)
+            failures += 1
+            if failures >= 10:
+                return
+            time.sleep(min(1.0 * 2 ** (failures - 1), 30.0))
             continue
         h = handle_ref()
         if h is None:

