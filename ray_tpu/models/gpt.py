@@ -176,11 +176,13 @@ def _dense_causal_attention(q, k, v):
 
 
 def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
-           attn_fn: Callable, x, layer_params):
+           attn_fn: Callable, x, layer_params, moe_ep_axis=None):
     """One transformer block. `layer_params` has the [L] dim already sliced.
 
     Returns (x, aux) — aux is the MoE load-balance loss for this layer
     (0.0 for a dense FFN) so the scan over layers can accumulate it.
+    ``moe_ep_axis`` switches the MoE to its shard_map expert-parallel mode
+    (weights pre-sharded on the expert dim; see ops/moe.py).
     """
     lc = (lambda a, ax: with_logical_constraint(a, rules, ax)) if rules \
         else (lambda a, ax: a)
@@ -202,7 +204,8 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
     if cfg.num_experts:
         from ray_tpu.ops.moe import moe_mlp
         h, aux = moe_mlp(h, p["mlp"], top_k=cfg.expert_top_k,
-                         capacity_factor=cfg.capacity_factor, lc=lc)
+                         capacity_factor=cfg.capacity_factor, lc=lc,
+                         ep_axis=moe_ep_axis)
     else:
         aux = jnp.zeros((), jnp.float32)
         h = jnp.einsum("bsd,dm->bsm", h, p["mlp"]["wi"].astype(dt)) \
@@ -293,16 +296,22 @@ def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
     else:
         logits = forward_fn(params, toks[:, :-1])
     targets = toks[:, 1:]
-    # Fused cross-entropy: ll_i = logit[target_i] - logsumexp(logits_i),
-    # written so XLA fuses the f32 upcast into the reductions and never
-    # materializes an f32 [B,S,V] tensor.
+    return -jnp.mean(token_loglikes(logits, targets)) \
+        + cfg.moe_aux_coef * aux
+
+
+def token_loglikes(logits, targets) -> jax.Array:
+    """Fused cross-entropy core: ll_i = logit[target_i] - logsumexp_i.
+
+    Written so XLA fuses the f32 upcast into the reductions and never
+    materializes an f32 [..., V] tensor; shared by the standard and the
+    pipelined (per-microbatch drain) loss paths.  Returns f32 [...]."""
     m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     z = (logits - m).astype(jnp.float32)
     lse = jnp.log(jnp.sum(jnp.exp(z), axis=-1)) + m[..., 0].astype(
         jnp.float32)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ll = tgt.astype(jnp.float32) - lse
-    return -jnp.mean(ll) + cfg.moe_aux_coef * aux
+    return tgt.astype(jnp.float32) - lse
 
 
 # ---------------------------------------------------------------- train step
@@ -320,15 +329,22 @@ def make_train_state(rng, cfg: GPTConfig, learning_rate: float = 3e-4,
 def make_train_step(cfg: GPTConfig, tx,
                     rules: Optional[LogicalAxisRules] = None,
                     mesh=None, donate: bool = True,
-                    forward_fn: Optional[Callable] = None):
+                    forward_fn: Optional[Callable] = None,
+                    loss_fn: Optional[Callable] = None):
     """Returns jittable (params, opt_state, batch) -> (params, opt_state,
     metrics).  Under a Mesh + sharded inputs, XLA emits all collectives
     (gradient reduction across dp/fsdp, tp/sp activation collectives) — the
-    TPU equivalent of the reference's DDP allreduce hook."""
+    TPU equivalent of the reference's DDP allreduce hook.
+
+    ``loss_fn(params, batch) -> scalar`` overrides the whole loss (the
+    pipelined trainer plugs its fused-epilogue loss in here), so the
+    optimizer/metric plumbing lives in exactly one place."""
+    if loss_fn is None:
+        def loss_fn(params, batch):
+            return gpt_loss(params, batch, cfg, rules, mesh, forward_fn)
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(gpt_loss)(params, batch, cfg, rules,
-                                                   mesh, forward_fn)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         import optax
         params = optax.apply_updates(params, updates)

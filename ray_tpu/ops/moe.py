@@ -74,13 +74,24 @@ def moe_router(x, router_w, *, top_k: int, capacity: int):
 
 
 def moe_mlp(x, p, *, top_k: int, capacity_factor: float,
-            lc: Optional[Callable] = None) -> Tuple[jax.Array, jax.Array]:
+            lc: Optional[Callable] = None,
+            ep_axis: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
     """Expert-parallel FFN (drop-in for the dense MLP body of a block).
 
     x [B,S,D]; p = {"router": [D,E] f32, "wi": [E,D,M], "bi": [E,M],
     "wo": [E,M,D], "bo": [E,D]} (expert dim carries the "expert" logical
     axis -> ep mesh axis).  ``lc(array, logical_axes)`` applies sharding
     constraints (identity when running unsharded / inside shard_map).
+
+    Two expert-parallel modes:
+      * GSPMD (default): expert weights/activations carry the "expert"
+        logical axis; XLA emits the dispatch all-to-alls.
+      * shard_map (``ep_axis`` set): weights arrive PRE-SHARDED on their
+        leading expert dim ([E/ep, ...]); each ep member runs its local
+        experts on the (ep-replicated) token batch and an all_gather over
+        ``ep_axis`` reassembles expert outputs.  This is how MoE composes
+        inside manually-mapped programs like the GPipe pipeline, where
+        GSPMD constraints don't apply.
     Returns (y [B,S,D], aux_loss).
     """
     if lc is None:
@@ -94,8 +105,18 @@ def moe_mlp(x, p, *, top_k: int, capacity_factor: float,
         x, p["router"].astype(jnp.float32), top_k=top_k, capacity=capacity)
 
     # Data-sharded -> expert-sharded: XLA emits the all-to-all here.
-    xe = jnp.einsum("bsd,bsec->ebcd", x, dispatch.astype(dt))
-    xe = lc(xe, ("expert", "batch", None, "embed"))
+    if ep_axis is not None:
+        # Slice the dispatch tensor to this member's experts BEFORE the
+        # contraction: 1/ep of the dispatch FLOPs and no full-E [E,B,C,D]
+        # buffer per pipeline tick.
+        e_local = p["wi"].shape[0]
+        idx = jax.lax.axis_index(ep_axis)
+        disp_local = jax.lax.dynamic_slice_in_dim(
+            dispatch, idx * e_local, e_local, 2)
+        xe = jnp.einsum("bsd,bsec->ebcd", x, disp_local.astype(dt))
+    else:
+        xe = jnp.einsum("bsd,bsec->ebcd", x, dispatch.astype(dt))
+        xe = lc(xe, ("expert", "batch", None, "embed"))
     h = jnp.einsum("ebcd,edm->ebcm", xe, p["wi"].astype(dt)) \
         + p["bi"].astype(dt)[:, None, None, :]
     h = lc(h, ("expert", "batch", None, "mlp"))
@@ -103,6 +124,8 @@ def moe_mlp(x, p, *, top_k: int, capacity_factor: float,
     ye = jnp.einsum("ebcm,emd->ebcd", h, p["wo"].astype(dt)) \
         + p["bo"].astype(dt)[:, None, None, :]
     ye = lc(ye, ("expert", "batch", None, "embed"))
+    if ep_axis is not None:
+        ye = jax.lax.all_gather(ye, ep_axis, axis=0, tiled=True)
     # Expert-sharded -> data-sharded: the return all-to-all.
     y = jnp.einsum("ebcd,bsec->bsd", ye, combine.astype(dt))
     return lc(y, ("batch", "seq", "embed")), aux

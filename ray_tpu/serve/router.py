@@ -79,7 +79,10 @@ class DeploymentHandle:
                                  name=f"serve-longpoll-{self._name}",
                                  daemon=True)
             self._listener = t
-        t.start()
+            # start() inside the lock: a not-yet-started thread reports
+            # is_alive()==False, which would let a concurrent caller spawn
+            # a duplicate listener.
+            t.start()
 
     def _pick(self):
         with self._lock:

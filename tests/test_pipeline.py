@@ -83,3 +83,71 @@ def test_odd_microbatch_count():
     got = float(gpt_loss_pipelined(params, batch, cfg, mesh,
                                    num_microbatches=3))
     assert abs(got - ref) < 1e-5
+
+
+def test_pipeline_with_flash_attention():
+    """Flash attention (Pallas interpret on CPU) inside pipeline stages
+    must match the non-pipelined dense loss (VERDICT r2 #10)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = MeshSpec(dp=2, pp=2).build()
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=4,
+                    num_heads=2, embed_dim=32, dtype=jnp.float32,
+                    attention="flash")
+    params = gpt_init(jax.random.PRNGKey(2), cfg)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    tokens = np.random.RandomState(1).randint(0, 128, (8, 33))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    ref = float(gpt_loss(params, batch, cfg))
+    got = float(gpt_loss_pipelined(params, batch, cfg, mesh,
+                                   num_microbatches=4))
+    assert abs(got - ref) < 1e-4
+
+
+def test_pipeline_moe_ep_aux_preserved():
+    """pp x ep: expert weights shard over ep inside the stages and the
+    load-balance aux loss survives the schedule — the pipelined loss
+    (which includes moe_aux_coef * aux) matches the GSPMD reference."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = MeshSpec(dp=2, pp=2, ep=2).build()
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=4,
+                    num_heads=2, embed_dim=32, dtype=jnp.float32,
+                    num_experts=4, expert_top_k=2)
+    params = gpt_init(jax.random.PRNGKey(3), cfg)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    tokens = np.random.RandomState(2).randint(0, 128, (8, 33))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    ref = float(gpt_loss(params, batch, cfg))          # includes aux term
+    got = float(gpt_loss_pipelined(params, batch, cfg, mesh,
+                                   num_microbatches=4))
+    assert abs(got - ref) < 1e-4
+    # and the aux is genuinely nonzero (the term isn't vacuously matched)
+    from ray_tpu.models.gpt import gpt_forward_with_aux
+    _, aux = gpt_forward_with_aux(params, batch["tokens"][:, :-1], cfg)
+    assert float(aux) > 0.0
+
+
+def test_pipeline_moe_ep_trains():
+    """One pp x ep training step runs end to end and the loss is finite."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = MeshSpec(dp=2, pp=2, ep=2).build()
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=4,
+                    num_heads=2, embed_dim=32, dtype=jnp.float32,
+                    num_experts=4, expert_top_k=2)
+    params = gpt_init(jax.random.PRNGKey(4), cfg)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    tokens = np.random.RandomState(3).randint(0, 128, (8, 33))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    tx = optax.adamw(1e-3)
+    step = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4,
+                                    donate=False)
+    params2, _, m = step(params, tx.init(params), batch)
+    assert np.isfinite(float(m["loss"]))
+    # expert weights actually moved
+    d = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        params["layers"]["mlp"], params2["layers"]["mlp"]))
+    assert max(d) > 0.0
