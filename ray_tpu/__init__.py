@@ -31,6 +31,16 @@ def is_initialized() -> bool:
     return global_worker.connected
 
 
+def cancel(ref, *, force: bool = False) -> bool:
+    """Best-effort cancel of the normal task producing ``ref``; its
+    ``get`` raises TaskCancelledError (reference: ray.cancel).  Pending
+    tasks never start; running tasks get KeyboardInterrupt on their
+    execution thread; ``force=True`` kills the worker process.  For
+    actors use ``ray_tpu.kill``."""
+    from ray_tpu._private.worker import get_core
+    return get_core().cancel_task(ref, force=force)
+
+
 def remote(*args, **kwargs):
     """Decorator turning a function into a RemoteFunction or a class into an
     ActorClass.  Usable bare (@remote) or with options (@remote(num_cpus=2)).

@@ -105,6 +105,9 @@ class CoreWorker:
         # TaskEventBuffer, task_event_buffer.h).
         self._task_events: list = []
         self._event_flusher_started = False
+        # task_id hex -> cancellation state (reference task_manager's
+        # pending-task map feeding CancelTask).
+        self._cancel_state: Dict[str, dict] = {}
         # Pubsub: channel -> callbacks (reference pubsub/subscriber.h).
         self._subscriptions: Dict[str, list] = {}
 
@@ -926,15 +929,92 @@ class CoreWorker:
                 "scheduling": scheduling, "return_ids": return_ids,
                 "pins": pinned_args,
             }
-        asyncio.run_coroutine_threadsafe(
-            self._submit_and_track(spec, resources, scheduling, max_retries,
-                                   retry_exceptions, return_ids, pinned_args),
-            self.loop)
+        # Cancellation registry (reference core_worker.cc CancelTask):
+        # tracks the submission's asyncio task (pending-phase cancel) and
+        # the executing worker's connection (running-phase interrupt).
+        st = {"cancelled": False, "force": False, "worker_conn": None,
+              "atask": None}
+        self._cancel_state[task_id.hex()] = st
+        coro = self._submit_and_track(spec, resources, scheduling,
+                                      max_retries, retry_exceptions,
+                                      return_ids, pinned_args)
+        tid_hex = task_id.hex()
+
+        def _kick():
+            t = asyncio.ensure_future(coro)
+            st["atask"] = t
+
+            def _done(fut):
+                # A cancel delivered before the coroutine's FIRST step
+                # skips the body (and its except-CancelledError handler)
+                # entirely; only this callback can store the result then.
+                # If the body ran, it swallowed the CancelledError, so
+                # fut.cancelled() is False and nothing double-stores.
+                if fut.cancelled():
+                    self._store_cancelled(spec, return_ids)
+                    self._cancel_state.pop(tid_hex, None)
+
+            t.add_done_callback(_done)
+
+        self.loop.call_soon_threadsafe(_kick)
         return refs
+
+    def cancel_task(self, ref, force: bool = False) -> bool:
+        """Best-effort cancel of the normal task producing ``ref``
+        (reference python/ray/_private/worker.py cancel -> core_worker
+        CancelTask).  Pending tasks are dropped before execution; running
+        tasks get a KeyboardInterrupt on their execution thread
+        (``force=True`` kills the worker process instead).  Returns False
+        when the ref is not an owned in-flight normal-task output."""
+        lin = self._lineage.get(ref.id.hex())
+        if lin is None:
+            return False
+        tid = lin["spec"]["task_id"]
+        st = self._cancel_state.get(tid)
+        if st is None:
+            return False
+
+        def _do():
+            st["cancelled"] = True
+            st["force"] = force
+            conn = st.get("worker_conn")
+            if conn is not None and not conn.closed:
+                asyncio.ensure_future(conn.notify(
+                    {"type": "cancel_task", "task_id": tid,
+                     "force": force}))
+            else:
+                t = st.get("atask")
+                if t is not None:
+                    t.cancel()
+
+        self.loop.call_soon_threadsafe(_do)
+        return True
+
+    def _store_cancelled(self, spec, return_ids):
+        payload = cloudpickle.dumps((rex.TaskCancelledError(
+            f"task {spec.get('name', '?')} "
+            f"({spec['task_id'][:8]}) was cancelled"), ""))
+        for oid in return_ids:
+            self._store_local(oid.hex(), "err", payload)
 
     async def _submit_and_track(self, spec, resources, scheduling, max_retries,
                                 retry_exceptions, return_ids,
                                 pinned_args=None):
+        try:
+            await self._submit_and_track_inner(
+                spec, resources, scheduling, max_retries, retry_exceptions,
+                return_ids)
+        except asyncio.CancelledError:
+            # Pending-phase ray_tpu.cancel(): the lease (if any) was
+            # returned by _submit_once's finally on the way out.
+            self._store_cancelled(spec, return_ids)
+        finally:
+            self._cancel_state.pop(spec["task_id"], None)
+
+    async def _submit_and_track_inner(self, spec, resources, scheduling,
+                                      max_retries, retry_exceptions,
+                                      return_ids):
+        cancel_st = self._cancel_state.get(spec["task_id"], {})
         attempts = max_retries + 1
         last_err: Optional[BaseException] = None
         attempt = 0
@@ -944,9 +1024,17 @@ class CoreWorker:
         # re-push — the user budget is for application failures.
         sys_budget = 10
         while attempt < attempts:
+            if cancel_st.get("cancelled"):
+                self._store_cancelled(spec, return_ids)
+                return
             try:
                 reply = await self._submit_once(spec, resources, scheduling)
             except ConnectionLost:
+                if cancel_st.get("cancelled"):
+                    # force-cancel killed the worker: that's the requested
+                    # outcome, not a crash to retry.
+                    self._store_cancelled(spec, return_ids)
+                    return
                 last_err = rex.WorkerCrashedError(
                     f"worker died executing task {spec['name']}")
                 attempt += 1
@@ -956,6 +1044,10 @@ class CoreWorker:
                 break
             if reply.get("ok"):
                 await self._store_task_returns(reply, return_ids)
+                return
+            if reply.get("cancelled"):
+                for oid in return_ids:
+                    self._store_local(oid.hex(), "err", reply["error"])
                 return
             if reply.get("retriable") and sys_budget > 0:
                 sys_budget -= 1
@@ -1046,6 +1138,37 @@ class CoreWorker:
             return None
         return await self._get_worker_conn(target["address"])
 
+    async def _lease_request(self, conn, lease_msg: dict) -> dict:
+        """Cancellation-safe lease request.
+
+        A pending-phase ray_tpu.cancel() cancels the submission coroutine
+        while this request is in flight — but the raylet may already have
+        granted (or be about to grant) the lease, and dropping that reply
+        would leak the worker as busy forever.  Shield the request and, on
+        cancellation, attach a callback that returns any late grant."""
+        req = asyncio.ensure_future(conn.request(
+            lease_msg, timeout=_rt_config().lease_request_timeout_s))
+        try:
+            return await asyncio.shield(req)
+        except asyncio.CancelledError:
+            def _return_late_grant(fut):
+                if fut.cancelled() or fut.exception() is not None:
+                    return
+                g = fut.result()
+                if isinstance(g, dict) and "lease_id" in g:
+                    asyncio.ensure_future(conn.request({
+                        "type": "return_lease",
+                        "lease_id": g["lease_id"],
+                        "worker_id": g["worker_id"],
+                        "resources": g["resources"],
+                        "pg_id": g.get("pg_id"),
+                        "bundle_index": g.get("bundle_index", 0),
+                        "worker_reusable": True,
+                    }))
+
+            req.add_done_callback(_return_late_grant)
+            raise
+
     async def _submit_once(self, spec, resources, scheduling) -> dict:
         logger.debug("task %s %s: leasing", spec["task_id"][:8],
                      spec["name"])
@@ -1105,8 +1228,7 @@ class CoreWorker:
                     if n["node_id"] == target_node:
                         raylet = await self._get_worker_conn(n["address"])
                         break
-        grant = await raylet.request(
-            lease_msg, timeout=_rt_config().lease_request_timeout_s)
+        grant = await self._lease_request(raylet, lease_msg)
         grant_conn = raylet   # the raylet that actually granted the lease
         visited = []
         max_hops = _rt_config().max_spillback_hops
@@ -1121,8 +1243,7 @@ class CoreWorker:
                 # saturated cluster): stop spilling and QUEUE at the final
                 # node — transient saturation must wait, not fail.
                 lease_msg["no_spill"] = True
-            grant = await spill_conn.request(
-                lease_msg, timeout=_rt_config().lease_request_timeout_s)
+            grant = await self._lease_request(spill_conn, lease_msg)
             grant_conn = spill_conn
         if "spillback" in grant:
             raise RuntimeError("lease spillback loop did not converge")
@@ -1132,13 +1253,29 @@ class CoreWorker:
         # never taken there and leak them on the grantor.
         lease_raylet = grant_conn
         crashed = False
+        cancel_st = self._cancel_state.get(spec["task_id"])
+        reusable = True
         try:
+            if cancel_st is not None:
+                if cancel_st.get("cancelled"):
+                    # Cancelled while leasing: don't start execution.  The
+                    # raise MUST sit inside this try so the finally below
+                    # returns the untouched lease.
+                    raise asyncio.CancelledError()
+                cancel_st["worker_conn"] = worker_conn
             logger.debug("task %s: pushing to %s", spec["task_id"][:8],
                          grant["worker_address"])
             reply = await worker_conn.request(
                 {"type": "push_task", "spec": spec}, timeout=None)
             logger.debug("task %s: reply ok=%s", spec["task_id"][:8],
                          reply.get("ok"))
+            # Never reuse a worker a cancel was aimed at — even if the
+            # task outran the injected KeyboardInterrupt and replied ok,
+            # the interrupt may still be pending on its exec thread and
+            # would hit (or kill the thread under) the next task.
+            reusable = not (reply.get("cancelled", False) or
+                            (cancel_st is not None and
+                             cancel_st.get("cancelled")))
             return reply
         except ConnectionLost:
             crashed = True
@@ -1152,7 +1289,7 @@ class CoreWorker:
                     "resources": grant["resources"],
                     "pg_id": grant.get("pg_id"),
                     "bundle_index": grant.get("bundle_index", 0),
-                    "worker_reusable": not crashed,
+                    "worker_reusable": (not crashed) and reusable,
                 })
             except Exception:
                 pass

@@ -42,6 +42,30 @@ class TaskExecutor:
         self._sem: asyncio.Semaphore = None
         self._exit_requested = False
         self._order: dict = {}
+        self._current_task_id: str = None
+
+    def _cancel_task(self, msg: dict) -> dict:
+        """Best-effort in-flight cancel (reference core_worker.cc
+        CancelTask -> KillActor/interrupt semantics for normal tasks).
+
+        force=True exits the process (the owner observes WorkerCrashed-
+        style death and maps it to TaskCancelledError); otherwise a
+        KeyboardInterrupt is injected into the execution thread.  The
+        injection is asynchronous-best-effort: a task that finishes in
+        the same instant can escape it, and C-level blocking calls only
+        see it on return to bytecode — same caveats as the reference.
+        """
+        tid = msg.get("task_id")
+        if self._current_task_id != tid:
+            return {"ok": True, "not_running": True}
+        if msg.get("force"):
+            os._exit(1)
+        import ctypes
+        for t in list(self.core.exec_pool._threads):
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(t.ident),
+                ctypes.py_object(KeyboardInterrupt))
+        return {"ok": True}
 
     async def handle(self, conn, msg: dict):
         mtype = msg["type"]
@@ -53,6 +77,8 @@ class TaskExecutor:
             return await self._actor_call(conn, msg)
         if mtype == "ping":
             return {"ok": True}
+        if mtype == "cancel_task":
+            return self._cancel_task(msg)
         if mtype == "exit":
             asyncio.get_running_loop().call_later(0.1, sys.exit, 0)
             return {"ok": True}
@@ -87,8 +113,20 @@ class TaskExecutor:
                             "task argument resolution timed out; lease "
                             "released for retry"))}
             loop = asyncio.get_running_loop()
-            result = await loop.run_in_executor(
-                self.core.exec_pool, lambda: fn(*args, **kwargs))
+            self._current_task_id = spec["task_id"]
+            try:
+                result = await loop.run_in_executor(
+                    self.core.exec_pool, lambda: fn(*args, **kwargs))
+            except KeyboardInterrupt:
+                # ray_tpu.cancel(): the interrupt was injected into the
+                # execution thread by _cancel_task.
+                status = "FAILED"
+                from ray_tpu import exceptions as rex
+                return {"ok": False, "cancelled": True,
+                        "error": _serialize_exception(rex.TaskCancelledError(
+                            f"task {spec['task_id'][:8]} was cancelled"))}
+            finally:
+                self._current_task_id = None
             # Borrow registrations must reach owners before the reply
             # releases the submitter's arg pins.
             await self.core.flush_borrow_acks()

@@ -68,3 +68,8 @@ class SchedulingError(RayTpuError):
 
 class PlacementGroupUnavailableError(RayTpuError):
     pass
+
+
+class TaskCancelledError(RayTpuError):
+    """The task producing this object was cancelled via ray_tpu.cancel()
+    (reference: ray.exceptions.TaskCancelledError)."""
