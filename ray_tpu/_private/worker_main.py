@@ -43,6 +43,8 @@ class TaskExecutor:
         self._exit_requested = False
         self._order: dict = {}
         self._current_task_id: str = None
+        self._task_handle = None
+        self._exec_started = False
 
     def _cancel_task(self, msg: dict) -> dict:
         """Best-effort in-flight cancel (reference core_worker.cc
@@ -60,6 +62,13 @@ class TaskExecutor:
             return {"ok": True, "not_running": True}
         if msg.get("force"):
             os._exit(1)
+        if not self._exec_started:
+            # Still loading/resolving args on the IO loop (can block for
+            # minutes on a pending upstream object): cancel the asyncio
+            # task — there is nothing on the exec thread to interrupt yet.
+            if self._task_handle is not None:
+                self._task_handle.cancel()
+            return {"ok": True}
         import ctypes
         for t in list(self.core.exec_pool._threads):
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
@@ -89,6 +98,13 @@ class TaskExecutor:
     async def _execute_task(self, spec: dict) -> dict:
         logger.debug("exec task %s %s: start", spec["task_id"][:8],
                      spec.get("name"))
+        # Visible to cancel_task from the moment the push arrives — a
+        # cancel landing during (possibly minutes-long) arg resolution
+        # cancels THIS asyncio task rather than injecting a thread
+        # interrupt that has nothing to hit yet.
+        self._current_task_id = spec["task_id"]
+        self._task_handle = asyncio.current_task()
+        self._exec_started = False
         t0 = time.time()
         status = "FINISHED"
         try:
@@ -113,13 +129,13 @@ class TaskExecutor:
                             "task argument resolution timed out; lease "
                             "released for retry"))}
             loop = asyncio.get_running_loop()
-            self._current_task_id = spec["task_id"]
+            self._exec_started = True
             try:
                 result = await loop.run_in_executor(
                     self.core.exec_pool, lambda: fn(*args, **kwargs))
-            except KeyboardInterrupt:
-                # ray_tpu.cancel(): the interrupt was injected into the
-                # execution thread by _cancel_task.
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                # ray_tpu.cancel(): either the injected thread interrupt
+                # or (pre-execution) this asyncio task's cancellation.
                 status = "FAILED"
                 from ray_tpu import exceptions as rex
                 return {"ok": False, "cancelled": True,
@@ -127,6 +143,7 @@ class TaskExecutor:
                             f"task {spec['task_id'][:8]} was cancelled"))}
             finally:
                 self._current_task_id = None
+                self._task_handle = None
             # Borrow registrations must reach owners before the reply
             # releases the submitter's arg pins.
             await self.core.flush_borrow_acks()
@@ -142,10 +159,22 @@ class TaskExecutor:
                                                   e.code or 0)
             return {"ok": False, "error": _serialize_exception(
                 RuntimeError("worker exited via SystemExit"))}
+        except asyncio.CancelledError:
+            # ray_tpu.cancel() during the load/resolve phase (cancel_task
+            # cancelled this asyncio task).  Reply instead of propagating:
+            # the owner is awaiting this push and maps the reply to
+            # TaskCancelledError.
+            status = "FAILED"
+            from ray_tpu import exceptions as rex
+            return {"ok": False, "cancelled": True,
+                    "error": _serialize_exception(rex.TaskCancelledError(
+                        f"task {spec['task_id'][:8]} was cancelled"))}
         except Exception as e:  # noqa: BLE001
             status = "FAILED"
             return {"ok": False, "error": _serialize_exception(e)}
         finally:
+            self._current_task_id = None
+            self._task_handle = None
             self.core.record_task_event({
                 "task_id": spec["task_id"], "name": spec.get("name"),
                 "kind": "task", "start": t0, "end": time.time(),

@@ -11,6 +11,7 @@ ready for device put, and ``split`` aligns shards with a train worker gang.
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
@@ -25,6 +26,7 @@ from ray_tpu.data.read_api import (
 
 __all__ = [
     "ActorPoolStrategy", "Dataset", "DatasetPipeline",
-    "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
+    "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
+    "range_tensor",
     "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",
 ]
