@@ -131,6 +131,14 @@ def timeline(filename=None):
     return _tl(filename)
 
 
+def usage_report() -> dict:
+    """Local-only usage snapshot (reference usage_lib without the
+    phone-home); also written to the log dir at shutdown unless
+    RT_USAGE_STATS=0."""
+    from ray_tpu._private.usage_stats import usage_report as _ur
+    return _ur()
+
+
 # ray_tpu.util is part of the public surface (reference: `ray.util` is
 # importable off the bare `import ray`); imported last to avoid cycles.
 from ray_tpu import util  # noqa: E402,F401

@@ -910,6 +910,11 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": self.address,
         }
+        from ray_tpu.util import tracing
+        if tracing.enabled():
+            # Propagate the caller's span so the executor's task span
+            # joins this trace (reference tracing_helper.py:53).
+            spec["trace"] = {"ctx": tracing.current_context()}
         scheduling = scheduling or {}
         resources = dict(resources or {"CPU": 1.0})
         # Ownership/lineage registration MUST precede scheduling the
@@ -1389,6 +1394,9 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": self.address,
         }
+        from ray_tpu.util import tracing
+        if tracing.enabled():
+            call["trace"] = {"ctx": tracing.current_context()}
         # Fire-and-forget hand-off: call_soon_threadsafe + ensure_future is
         # ~2x cheaper per call than run_coroutine_threadsafe (no
         # concurrent.futures.Future or chain callback), and nothing reads

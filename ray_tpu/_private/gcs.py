@@ -1011,9 +1011,30 @@ class GcsServer:
         return {"ok": True}
 
     async def _h_list_task_events(self, conn, msg):
+        """Filter push-down + pagination (reference state-API server-side
+        filtering): name/status/kind predicates apply BEFORE the limit
+        window, and (offset, limit) page newest-first so a driver never
+        ships the whole retention window to render one page."""
         limit = msg.get("limit", 10000)
-        evs = list(self.task_events)
-        return evs[-limit:]
+        offset = msg.get("offset", 0)
+        name = msg.get("name")
+        status = msg.get("status")
+        kind = msg.get("kind")
+        trace_id = msg.get("trace_id")
+        evs = self.task_events
+        sel = [e for e in evs
+               if (name is None or e.get("name") == name)
+               and (status is None or e.get("status") == status)
+               and (kind is None or e.get("kind") == kind)
+               and (trace_id is None or e.get("trace_id") == trace_id)]
+        total = len(sel)
+        # newest-first pagination: offset 0 = most recent `limit` events
+        if offset or limit < total:
+            end = total - offset
+            sel = sel[max(0, end - limit):max(0, end)]
+        if msg.get("with_total"):
+            return {"events": sel, "total": total}
+        return sel
 
     async def _h_list_objects(self, conn, msg):
         return [{"object_id": oid, "owner": e.owner,

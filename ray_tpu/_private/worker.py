@@ -181,6 +181,11 @@ class Worker:
             atexit.unregister(self.shutdown)
         except Exception:
             pass
+        if self.core_worker is not None and self.mode == "driver":
+            # Local-only usage snapshot (reference usage_lib, minus the
+            # phone-home: this environment has no egress by design).
+            from ray_tpu._private.usage_stats import write_report_at_shutdown
+            write_report_at_shutdown()
         if self.core_worker is not None:
             try:
                 self.core_worker.gcs_request({"type": "finish_job",

@@ -130,9 +130,26 @@ class TaskExecutor:
                             "released for retry"))}
             loop = asyncio.get_running_loop()
             self._exec_started = True
+            tr = spec.get("trace")
+            if tr is not None:
+                # Execute under a child span.  The span opens ON the exec
+                # thread, so nested .remote() calls from inside fn see the
+                # context and propagate it further.
+                from ray_tpu.util import tracing
+                tracing.enable()
+
+                def _traced():
+                    with tracing.span(f"task:{spec.get('name')}",
+                                      _remote_parent=(
+                                          tuple(tr["ctx"])
+                                          if tr.get("ctx") else None)):
+                        return fn(*args, **kwargs)
+                run = _traced
+            else:
+                run = lambda: fn(*args, **kwargs)  # noqa: E731
             try:
                 result = await loop.run_in_executor(
-                    self.core.exec_pool, lambda: fn(*args, **kwargs))
+                    self.core.exec_pool, run)
             except (KeyboardInterrupt, asyncio.CancelledError):
                 # ray_tpu.cancel(): either the injected thread interrupt
                 # or (pre-execution) this asyncio task's cancellation.
@@ -267,14 +284,30 @@ class TaskExecutor:
                     return {"ok": False, "retriable": True,
                             "error": _serialize_exception(RuntimeError(
                                 "actor-call argument resolution timed out"))}
+            tr = msg.get("trace")
+            if tr is not None:
+                from ray_tpu.util import tracing
+                tracing.enable()
+                parent = tuple(tr["ctx"]) if tr.get("ctx") else None
+                name = f"actor:{msg['method']}"
             if inspect.iscoroutinefunction(method):
                 async with self._sem:
                     self._advance(order, seq)
-                    result = await method(*args, **kwargs)
+                    if tr is not None:
+                        with tracing.span(name, _remote_parent=parent):
+                            result = await method(*args, **kwargs)
+                    else:
+                        result = await method(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
-                fut = loop.run_in_executor(
-                    self.core.exec_pool, lambda: method(*args, **kwargs))
+                if tr is not None:
+                    def _call(m=method, a=args, k=kwargs):
+                        with tracing.span(name, _remote_parent=parent):
+                            return m(*a, **k)
+                else:
+                    def _call(m=method, a=args, k=kwargs):
+                        return m(*a, **k)
+                fut = loop.run_in_executor(self.core.exec_pool, _call)
                 self._advance(order, seq)
                 result = await fut
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],

@@ -30,3 +30,7 @@ __all__ = [
     "range_tensor",
     "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu

@@ -30,3 +30,7 @@ __all__ = [
     "RolloutWorker", "SampleBatch", "Space", "VectorEnv", "WorkerSet",
     "compute_gae", "make_vector_env", "register_env",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu

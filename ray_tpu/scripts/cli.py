@@ -196,6 +196,12 @@ def cmd_microbenchmark(args):
     bench_main()
 
 
+def cmd_usage(args):
+    _connect(args)
+    import ray_tpu
+    print(json.dumps(ray_tpu.usage_report(), indent=2, default=str))
+
+
 # --------------------------------------------------------------------- jobs
 
 
@@ -264,6 +270,10 @@ def main(argv=None):
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("usage", help="print the local usage report")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_usage)
 
     sp = sub.add_parser("timeline", help="dump Chrome trace of task events")
     sp.add_argument("--address")

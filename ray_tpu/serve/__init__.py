@@ -197,3 +197,7 @@ def shutdown():
             ray_tpu.kill(a)
         except Exception:
             pass
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

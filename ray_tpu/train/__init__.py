@@ -29,3 +29,7 @@ __all__ = [
     "JaxPredictor",
     "Predictor",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu

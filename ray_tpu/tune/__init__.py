@@ -28,3 +28,7 @@ __all__ = [
     "quniform", "randint", "randn", "uniform", "sample_from",
     "with_parameters", "with_resources", "report", "get_checkpoint",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

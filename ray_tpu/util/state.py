@@ -26,10 +26,20 @@ def list_actors() -> List[Dict[str, Any]]:
     return _gcs_request({"type": "list_actors"})
 
 
-def list_tasks(limit: int = 20000) -> List[Dict[str, Any]]:
+def list_tasks(limit: int = 20000, *, offset: int = 0,
+               name: Optional[str] = None, status: Optional[str] = None,
+               kind: Optional[str] = None,
+               trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Finished/failed task executions from the GCS task-event log.
-    Default limit matches the GCS's 20000-event retention window."""
-    return _gcs_request({"type": "list_task_events", "limit": limit})
+
+    Filters (name/status/kind) are pushed down to the GCS and applied
+    before the (offset, limit) page — newest first — so large retention
+    windows never ship to the driver wholesale (reference state API
+    server-side filtering; the event store itself is a bounded deque of
+    ``task_event_retention`` entries)."""
+    return _gcs_request({"type": "list_task_events", "limit": limit,
+                         "offset": offset, "name": name, "status": status,
+                         "kind": kind, "trace_id": trace_id})
 
 
 def list_objects() -> List[Dict[str, Any]]:

@@ -11,3 +11,7 @@ from ray_tpu.workflow.api import (get_output, get_status, init, list_all,
 
 __all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
            "list_all"]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu
