@@ -175,6 +175,16 @@ def _dense_causal_attention(q, k, v):
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
+def _dense_causal_attention_bnsh(q, k, v):
+    """[B,N,S,H] (head-major) dense attention; same math, no relayouts."""
+    S = q.shape[2]
+    scores = jnp.einsum("bnqh,bnkh->bnqk", q, k) / np.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bnkh->bnqh", probs, v)
+
+
 def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
            attn_fn: Callable, x, layer_params, moe_ep_axis=None):
     """One transformer block. `layer_params` has the [L] dim already sliced.
@@ -190,13 +200,25 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
     dt = cfg.dtype
 
     h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = jnp.einsum("bsd,dcnh->bscnh", h, p["attn"]["wqkv"].astype(dt))
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    q = lc(q, ("batch", "seq", "heads", "kv"))
-    k = lc(k, ("batch", "seq", "heads", "kv"))
-    v = lc(v, ("batch", "seq", "heads", "kv"))
-    o = attn_fn(q, k, v)
-    o = jnp.einsum("bsnh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+    if getattr(attn_fn, "_layout", "bsnh") == "bnsh":
+        # Head-major attention path: the qkv projection WRITES [B,N,S,H]
+        # (layout picked in the matmul epilogue, nearly free) so the flash
+        # kernels get their native view with zero standalone relayouts.
+        qkv = jnp.einsum("bsd,dcnh->bcnsh", h, p["attn"]["wqkv"].astype(dt))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        q = lc(q, ("batch", "heads", "seq", "kv"))
+        k = lc(k, ("batch", "heads", "seq", "kv"))
+        v = lc(v, ("batch", "heads", "seq", "kv"))
+        o = attn_fn(q, k, v)
+        o = jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+    else:
+        qkv = jnp.einsum("bsd,dcnh->bscnh", h, p["attn"]["wqkv"].astype(dt))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = lc(q, ("batch", "seq", "heads", "kv"))
+        k = lc(k, ("batch", "seq", "heads", "kv"))
+        v = lc(v, ("batch", "seq", "heads", "kv"))
+        o = attn_fn(q, k, v)
+        o = jnp.einsum("bsnh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
     x = x + o + p["attn"]["bo"].astype(dt)
     x = lc(x, ("batch", "seq", "embed"))
 
@@ -243,9 +265,14 @@ def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
             check_vma=False)
     elif cfg.attention == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
-        attn_fn = flash_attention
+
+        def attn_fn(q, k, v):
+            return flash_attention(q, k, v, True, None, None, None, None,
+                                   "bnsh")
+        attn_fn._layout = "bnsh"
     else:
-        attn_fn = _dense_causal_attention
+        attn_fn = _dense_causal_attention_bnsh
+        attn_fn._layout = "bnsh"
 
     x = params["wte"].astype(dt)[tokens] \
         + params["wpe"].astype(dt)[:S][None]

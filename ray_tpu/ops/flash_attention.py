@@ -130,18 +130,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
 
 
 def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                    sm_scale: Optional[float], interpret: bool):
-    """q,k,v: [B, S, N, H] -> (o: [B, S, N, H], lse: [B*N, S] f32)."""
-    B, S, N, H = q.shape
+                    sm_scale: Optional[float], interpret: bool,
+                    layout: str = "bsnh"):
+    """layout "bsnh": q,k,v [B, S, N, H] (folding costs a transpose).
+    layout "bnsh": q,k,v [B, N, S, H] — folding to the kernel's
+    [B*N, S, H] view is a FREE reshape; models that keep attention in
+    bnsh (the GPT block does) skip ~25% of attention wall-clock that
+    the bsnh relayouts cost at bench scale.
+    Returns (o in the input layout, lse [B*N, S] f32)."""
+    if layout == "bnsh":
+        B, N, S, H = q.shape
+        def _fold(x):
+            return x.reshape(B * N, S, H)
+        def _unfold(x):
+            return x.reshape(B, N, S, H)
+    else:
+        B, S, N, H = q.shape
+        def _fold(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+        def _unfold(x):
+            return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     assert S % block_q == 0 and S % block_k == 0, (
         f"seq {S} must divide blocks ({block_q},{block_k})")
-
-    # [B,S,N,H] -> [B*N, S, H]
-    def _fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
 
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=scale)
@@ -168,7 +181,7 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return (of.reshape(B, N, S, H).transpose(0, 2, 1, 3), lse[:, :, 0])
+    return _unfold(of), lse[:, :, 0]
 
 
 # ---------------------------------------------------------------- backward
@@ -278,15 +291,23 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, sm_scale: Optional[float], interpret: bool):
-    B, S, N, H = q.shape
+                    block_k: int, sm_scale: Optional[float],
+                    interpret: bool, layout: str = "bsnh"):
+    if layout == "bnsh":
+        B, N, S, H = q.shape
+        def _fold(x):
+            return x.reshape(B * N, S, H)
+        def _unfold(x):
+            return x.reshape(B, N, S, H)
+    else:
+        B, S, N, H = q.shape
+        def _fold(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+        def _unfold(x):
+            return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-
-    def _fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
-
     assert S % block_q == 0 and S % block_k == 0, (
         f"seq {S} must divide blocks ({block_q},{block_k})")
     qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(g)
@@ -341,9 +362,6 @@ def _flash_bwd_impl(q, k, v, o, lse, g, *, causal: bool, block_q: int,
         interpret=interpret,
     )(kf, vf, qf, dof, lse_l, delta_l)
 
-    def _unfold(x):
-        return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
-
     return _unfold(dqf), _unfold(dkf), _unfold(dvf)
 
 
@@ -360,26 +378,35 @@ def _dense_reference(q, k, v, causal, sm_scale):
     return jnp.einsum("bnqk,bknh->bqnh", p, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
-    """Fused causal attention. q,k,v: [batch, seq, heads, head_dim].
+                    interpret: Optional[bool] = None,
+                    layout: str = "bsnh"):
+    """Fused causal attention.
 
+    layout "bsnh" (default): q,k,v [batch, seq, heads, head_dim].
+    layout "bnsh": q,k,v [batch, heads, seq, head_dim] — the kernels'
+    native view; models that produce attention inputs head-major skip
+    the fold transposes entirely (~25% of attention time at short seq).
     block_q/block_k default to a per-shape heuristic (see _default_blocks)
     and honor any entry recorded by `tune_flash_blocks`.
     """
-    out, _ = _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret)
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret,
+                  layout)
     return out
 
 
-def _resolve(q, causal, block_q, block_k, interpret):
+def _resolve(q, causal, block_q, block_k, interpret, layout):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None or block_k is None:
-        B, S, N, H = q.shape
+        if layout == "bnsh":
+            B, N, S, H = q.shape
+        else:
+            B, S, N, H = q.shape
         key = (jax.default_backend(), B, S, N, H, str(q.dtype), causal)
         bq, bk = _TUNED.get(key) or _default_blocks(S, H)
         block_q = block_q or bq
@@ -387,19 +414,23 @@ def _resolve(q, causal, block_q, block_k, interpret):
     return block_q, block_k, interpret
 
 
-def _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret):
-    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret)
+def _fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret,
+         layout="bsnh"):
+    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret,
+                              layout)
     out, lse = _flash_fwd_impl(q, k, v, causal=causal, block_q=bq,
                                block_k=bk, sm_scale=sm_scale,
-                               interpret=interp)
+                               interpret=interp, layout=layout)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, sm_scale, interpret, res, g):
+def _bwd(causal, block_q, block_k, sm_scale, interpret, layout, res, g):
     q, k, v, o, lse = res
-    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret)
+    bq, bk, interp = _resolve(q, causal, block_q, block_k, interpret,
+                              layout)
     return _flash_bwd_impl(q, k, v, o, lse, g, causal=causal, block_q=bq,
-                           block_k=bk, sm_scale=sm_scale, interpret=interp)
+                           block_k=bk, sm_scale=sm_scale, interpret=interp,
+                           layout=layout)
 
 
 flash_attention.defvjp(_fwd, _bwd)
