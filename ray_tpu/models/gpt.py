@@ -185,6 +185,9 @@ def _dense_causal_attention_bnsh(q, k, v):
     return jnp.einsum("bnqk,bnkh->bnqh", probs, v)
 
 
+_dense_causal_attention_bnsh._layout = "bnsh"
+
+
 def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
            attn_fn: Callable, x, layer_params, moe_ep_axis=None):
     """One transformer block. `layer_params` has the [L] dim already sliced.
@@ -272,7 +275,6 @@ def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         attn_fn._layout = "bnsh"
     else:
         attn_fn = _dense_causal_attention_bnsh
-        attn_fn._layout = "bnsh"
 
     x = params["wte"].astype(dt)[tokens] \
         + params["wpe"].astype(dt)[:S][None]

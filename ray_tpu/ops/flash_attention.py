@@ -129,6 +129,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, o_scr, *,
         lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
+def _layout_views(shape, layout):
+    """(B, N, S, H, fold, unfold) for a q-shape under the given layout —
+    the ONE place the fwd and bwd impls get their layout handling from."""
+    if layout == "bnsh":
+        B, N, S, H = shape
+
+        def fold(x):
+            return x.reshape(B * N, S, H)
+
+        def unfold(x):
+            return x.reshape(B, N, S, H)
+    else:
+        B, S, N, H = shape
+
+        def fold(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+
+        def unfold(x):
+            return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+    return B, N, S, H, fold, unfold
+
+
 def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
                     sm_scale: Optional[float], interpret: bool,
                     layout: str = "bsnh"):
@@ -138,18 +160,7 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
     bnsh (the GPT block does) skip ~25% of attention wall-clock that
     the bsnh relayouts cost at bench scale.
     Returns (o in the input layout, lse [B*N, S] f32)."""
-    if layout == "bnsh":
-        B, N, S, H = q.shape
-        def _fold(x):
-            return x.reshape(B * N, S, H)
-        def _unfold(x):
-            return x.reshape(B, N, S, H)
-    else:
-        B, S, N, H = q.shape
-        def _fold(x):
-            return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
-        def _unfold(x):
-            return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+    B, N, S, H, _fold, _unfold = _layout_views(q.shape, layout)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
@@ -293,18 +304,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
 def _flash_bwd_impl(q, k, v, o, lse, g, *, causal: bool, block_q: int,
                     block_k: int, sm_scale: Optional[float],
                     interpret: bool, layout: str = "bsnh"):
-    if layout == "bnsh":
-        B, N, S, H = q.shape
-        def _fold(x):
-            return x.reshape(B * N, S, H)
-        def _unfold(x):
-            return x.reshape(B, N, S, H)
-    else:
-        B, S, N, H = q.shape
-        def _fold(x):
-            return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
-        def _unfold(x):
-            return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+    B, N, S, H, _fold, _unfold = _layout_views(q.shape, layout)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(H)
     block_q = min(block_q, S)
     block_k = min(block_k, S)

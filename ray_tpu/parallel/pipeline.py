@@ -168,7 +168,9 @@ def gpipe_fused_loss_spmd(block_fn: Callable, loss_mb_fn: Callable,
 # ------------------------------------------------------- GPT integration
 
 def _attn_fn_for(cfg):
-    from ray_tpu.models.gpt import _dense_causal_attention
+    """Same head-major (bnsh) selections the non-pipelined block uses —
+    pipelined stages must not silently keep the relayout-paying path."""
+    from ray_tpu.models.gpt import _dense_causal_attention_bnsh
 
     assert cfg.attention in ("dense", "flash"), (
         f"pipelined stages support dense or flash attention, got "
@@ -176,8 +178,13 @@ def _attn_fn_for(cfg):
         f"not thread through a pipeline stage)")
     if cfg.attention == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
-        return flash_attention
-    return _dense_causal_attention
+
+        def attn_fn(q, k, v):
+            return flash_attention(q, k, v, True, None, None, None, None,
+                                   "bnsh")
+        attn_fn._layout = "bnsh"
+        return attn_fn
+    return _dense_causal_attention_bnsh
 
 
 def _layer_in_specs(cfg, mesh) -> Any:

@@ -145,3 +145,26 @@ def test_ulysses_matches_dense():
                        in_specs=(spec, spec, spec), out_specs=spec)
     out = fn(q, k, v)
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_flash_bnsh_layout_forward_and_grads():
+    """Head-major layout: forward AND gradients must match the bsnh path
+    (the GPT block's default attention now runs through bnsh)."""
+    q, k, v = _qkv(10, B=2, S=32, N=4, H=8)
+    qb, kb, vb = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    out_b = flash_attention(qb, kb, vb, True, 16, 16, None, None, "bnsh")
+    ref = _dense_reference(q, k, v, True, None)
+    np.testing.assert_allclose(out_b.transpose(0, 2, 1, 3), ref, atol=2e-5)
+
+    def loss_bnsh(q, k, v):
+        return flash_attention(q, k, v, True, 16, 16, None, None,
+                               "bnsh").sum()
+
+    def loss_dense(q, k, v):
+        return _dense_reference(q, k, v, True, None).sum()
+
+    g_b = jax.grad(loss_bnsh, argnums=(0, 1, 2))(qb, kb, vb)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_b, g_d):
+        np.testing.assert_allclose(a.transpose(0, 2, 1, 3), b, atol=2e-5)
