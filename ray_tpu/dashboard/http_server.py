@@ -2,7 +2,9 @@
 
 Design analog: reference ``dashboard/`` (DashboardHead head.py:70 + REST
 modules + StateAggregator).  Scope here is the REST surface the state CLI
-and external monitors consume — no React client; the JSON endpoints mirror
+and external monitors consume, plus a dependency-free single-file live UI
+at ``/`` (auto-refreshing summary cards + node/actor/job/task tables) in
+place of the reference's React client; the JSON endpoints mirror
 ``ray list ...``/``ray summary`` and Prometheus-style metrics.  Implemented
 as a dependency-free asyncio HTTP/1.1 GET server co-hosted with the GCS
 (direct in-process table reads, no RPC hop).
@@ -11,7 +13,7 @@ Routes:
   GET /api/nodes | /api/actors | /api/tasks | /api/objects
       /api/placement_groups | /api/jobs | /api/cluster_summary
   GET /api/metrics      (Prometheus text exposition)
-  GET /                 (tiny HTML index)
+  GET /                 (live HTML dashboard)
 """
 
 from __future__ import annotations
@@ -80,13 +82,7 @@ class DashboardHttpServer:
     async def _route(self, writer, path: str):
         g = self.gcs
         if path == "/":
-            body = (b"<html><body><h3>ray_tpu dashboard</h3><ul>" +
-                    b"".join(f'<li><a href="/api/{p}">{p}</a></li>'.encode()
-                             for p in ("nodes", "actors", "tasks", "objects",
-                                       "placement_groups", "jobs",
-                                       "cluster_summary", "metrics")) +
-                    b"</ul></body></html>")
-            await self._respond(writer, 200, body, "text/html")
+            await self._respond(writer, 200, _INDEX_HTML, "text/html")
             return
         if path == "/api/metrics":
             await self._respond(writer, 200, self._prometheus().encode(),
@@ -168,3 +164,75 @@ class DashboardHttpServer:
         # series names depending on scrape point.
         return "\n".join(lines) + "\n" + \
             render_prometheus(self.gcs.aggregated_metrics())
+
+
+# Single-file live UI (reference: the dashboard/client React app, scaled to
+# one dependency-free page): auto-refreshing cluster summary, node/actor/
+# job tables, and recent task activity, all straight off /api/*.
+_INDEX_HTML = b"""<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f5f6f8;color:#1c2126}
+ header{background:#1c2126;color:#fff;padding:10px 20px;display:flex;align-items:baseline;gap:16px}
+ header h1{font-size:16px;margin:0} header span{color:#9aa4ad;font-size:12px}
+ main{padding:16px 20px;max-width:1100px;margin:auto}
+ .cards{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px}
+ .card{background:#fff;border-radius:8px;padding:10px 16px;box-shadow:0 1px 2px rgba(0,0,0,.08);min-width:110px}
+ .card b{display:block;font-size:22px} .card span{font-size:12px;color:#67707a}
+ h2{font-size:13px;text-transform:uppercase;letter-spacing:.05em;color:#67707a;margin:18px 0 6px}
+ table{width:100%;border-collapse:collapse;background:#fff;border-radius:8px;overflow:hidden;box-shadow:0 1px 2px rgba(0,0,0,.08);font-size:13px}
+ th,td{text-align:left;padding:6px 10px;border-bottom:1px solid #eef0f2;white-space:nowrap;overflow:hidden;text-overflow:ellipsis;max-width:260px}
+ th{background:#fafbfc;font-weight:600;color:#49525b}
+ .ok{color:#0a7d33;font-weight:600} .bad{color:#b3261e;font-weight:600}
+ footer{color:#9aa4ad;font-size:11px;padding:14px 20px}
+</style></head><body>
+<header><h1>ray_tpu dashboard</h1><span id=upd></span>
+<span><a href="/api/metrics" style="color:#9ec5fe">prometheus</a></span></header>
+<main>
+ <div class=cards id=cards></div>
+ <h2>Nodes</h2><table id=nodes></table>
+ <h2>Actors</h2><table id=actors></table>
+ <h2>Jobs</h2><table id=jobs></table>
+ <h2>Recent tasks</h2><table id=tasks></table>
+</main>
+<footer>auto-refreshes every 2s &middot; raw endpoints: /api/nodes /api/actors
+/api/tasks /api/objects /api/placement_groups /api/jobs /api/cluster_summary</footer>
+<script>
+const J=(u)=>fetch(u).then(r=>r.json());
+const esc=(s)=>String(s??"").replace(/[&<>]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+function tbl(el,heads,rows){
+ el.innerHTML="<tr>"+heads.map(h=>"<th>"+h+"</th>").join("")+"</tr>"+
+  rows.map(r=>"<tr>"+r.map(c=>"<td>"+c+"</td>").join("")+"</tr>").join("");
+}
+async function tick(){
+ try{
+  const [sum,nodes,actors,jobs,tasks]=await Promise.all([
+    J("/api/cluster_summary"),J("/api/nodes"),J("/api/actors"),
+    J("/api/jobs"),J("/api/tasks")]);
+  const res=(sum.resources||{}).total||{}; const cards=document.getElementById("cards");
+  const card=(v,l)=>`<div class=card><b>${v}</b><span>${l}</span></div>`;
+  cards.innerHTML=card((sum.nodes||{}).alive??nodes.filter(n=>n.alive).length,"nodes alive")
+   +card((sum.actors||{}).alive??actors.filter(a=>a.state=="ALIVE").length,"actors alive")
+   +card(res.CPU??"-","CPUs")+card(res.TPU??"-","TPUs")
+   +card(tasks.length,"task events");
+  tbl(document.getElementById("nodes"),["node","address","alive","resources"],
+   nodes.map(n=>[esc((n.node_id||"").slice(0,12)),esc(n.address),
+    n.alive?'<span class=ok>alive</span>':'<span class=bad>dead</span>',
+    esc(JSON.stringify(n.resources_total||n.resources||{}))]));
+  tbl(document.getElementById("actors"),["actor","name","state","node"],
+   actors.slice(0,50).map(a=>[esc((a.actor_id||"").slice(0,12)),esc(a.name||""),
+    a.state=="ALIVE"?'<span class=ok>ALIVE</span>':'<span class=bad>'+esc(a.state)+'</span>',
+    esc((a.node_id||"").slice(0,12))]));
+  tbl(document.getElementById("jobs"),["job","state","started"],
+   jobs.slice(0,30).map(j=>[esc(j.job_id||""),esc(j.state||""),
+    j.start_time?new Date(j.start_time*1000).toLocaleTimeString():""]));
+  tbl(document.getElementById("tasks"),["name","kind","status","duration"],
+   tasks.slice(-30).reverse().map(t=>[esc(t.name||""),esc(t.kind||""),
+    t.status=="FINISHED"?'<span class=ok>FINISHED</span>':'<span class=bad>'+esc(t.status)+'</span>',
+    ((t.end-t.start)*1000).toFixed(1)+" ms"]));
+  document.getElementById("upd").textContent="updated "+new Date().toLocaleTimeString();
+ }catch(e){document.getElementById("upd").textContent="refresh failed: "+e;}
+}
+tick(); setInterval(tick,2000);
+</script></body></html>
+"""
