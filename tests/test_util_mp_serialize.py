@@ -83,3 +83,32 @@ def test_inspect_serializability_finds_inner_lock():
 
     ok, failures = inspect_serializability(lambda x: x + 1)
     assert ok and failures == []
+
+
+def test_joblib_backend(mp_cluster):
+    import math
+
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=2)(delayed(math.sqrt)(i) for i in range(12))
+    assert out == [math.sqrt(i) for i in range(12)]
+
+
+def test_joblib_backend_sklearn_style(mp_cluster):
+    """A cross-validation-shaped workload: stateful fn + kwargs batches."""
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+
+    def fit_score(fold, reg=1.0):
+        return fold * reg
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = Parallel()(delayed(fit_score)(f, reg=0.5) for f in range(8))
+    assert out == [f * 0.5 for f in range(8)]
