@@ -32,11 +32,15 @@ def is_initialized() -> bool:
 
 
 def cancel(ref, *, force: bool = False) -> bool:
-    """Best-effort cancel of the normal task producing ``ref``; its
-    ``get`` raises TaskCancelledError (reference: ray.cancel).  Pending
-    tasks never start; running tasks get KeyboardInterrupt on their
-    execution thread; ``force=True`` kills the worker process.  For
-    actors use ``ray_tpu.kill``."""
+    """Best-effort cancel of the task producing ``ref``; its ``get``
+    raises TaskCancelledError (reference: ray.cancel).
+
+    Normal tasks: pending ones never start; running ones get a
+    KeyboardInterrupt on their execution thread; ``force=True`` kills the
+    worker process.  Actor calls: cancellable while queued / resolving
+    args / awaiting an async method; a sync method already executing
+    cannot be interrupted, and ``force=True`` raises ValueError (use
+    ``ray_tpu.kill`` to destroy the actor itself)."""
     from ray_tpu._private.worker import get_core
     return get_core().cancel_task(ref, force=force)
 

@@ -106,8 +106,11 @@ class CoreWorker:
         self._task_events: list = []
         self._event_flusher_started = False
         # task_id hex -> cancellation state (reference task_manager's
-        # pending-task map feeding CancelTask).
+        # pending-task map feeding CancelTask); _cancel_refs maps the
+        # first return-object id back to its task, popped together with
+        # the state when the call resolves (bounded by in-flight calls).
         self._cancel_state: Dict[str, dict] = {}
+        self._cancel_refs: Dict[str, str] = {}
         # Pubsub: channel -> callbacks (reference pubsub/subscriber.h).
         self._subscriptions: Dict[str, list] = {}
 
@@ -940,6 +943,8 @@ class CoreWorker:
         st = {"cancelled": False, "force": False, "worker_conn": None,
               "atask": None}
         self._cancel_state[task_id.hex()] = st
+        for oid in return_ids:
+            self._cancel_refs[oid.hex()] = task_id.hex()
         coro = self._submit_and_track(spec, resources, scheduling,
                                       max_retries, retry_exceptions,
                                       return_ids, pinned_args)
@@ -958,6 +963,8 @@ class CoreWorker:
                 if fut.cancelled():
                     self._store_cancelled(spec, return_ids)
                     self._cancel_state.pop(tid_hex, None)
+                    for oid in return_ids:
+                        self._cancel_refs.pop(oid.hex(), None)
 
             t.add_done_callback(_done)
 
@@ -965,19 +972,40 @@ class CoreWorker:
         return refs
 
     def cancel_task(self, ref, force: bool = False) -> bool:
-        """Best-effort cancel of the normal task producing ``ref``
-        (reference python/ray/_private/worker.py cancel -> core_worker
-        CancelTask).  Pending tasks are dropped before execution; running
-        tasks get a KeyboardInterrupt on their execution thread
-        (``force=True`` kills the worker process instead).  Returns False
-        when the ref is not an owned in-flight normal-task output."""
-        lin = self._lineage.get(ref.id.hex())
-        if lin is None:
-            return False
-        tid = lin["spec"]["task_id"]
+        """Best-effort cancel of the task producing ``ref`` (reference
+        python/ray/_private/worker.py cancel -> core_worker CancelTask).
+
+        Normal tasks: pending submissions are dropped before execution;
+        running ones get a KeyboardInterrupt on their execution thread
+        (``force=True`` kills the worker process instead).  Actor calls:
+        cancellable while queued / resolving args / awaiting an async
+        method; a sync method already executing is not interruptible
+        (and ``force`` raises, matching the reference).  Returns False
+        when the ref is not an owned in-flight call's output."""
+        tid = self._cancel_refs.get(ref.id.hex())
+        if tid is None:
+            lin = self._lineage.get(ref.id.hex())
+            if lin is None:
+                return False
+            tid = lin["spec"]["task_id"]
         st = self._cancel_state.get(tid)
         if st is None:
             return False
+        if "actor" in st:
+            if force:
+                raise ValueError(
+                    "force=True is not supported for actor tasks "
+                    "(use ray_tpu.kill to destroy the actor)")
+
+            def _do_actor():
+                st["cancelled"] = True
+                conn = self.actor_state.get(st["actor"], {}).get("conn")
+                if conn is not None and not conn.closed:
+                    asyncio.ensure_future(conn.notify(
+                        {"type": "cancel_task", "task_id": tid}))
+
+            self.loop.call_soon_threadsafe(_do_actor)
+            return True
 
         def _do():
             st["cancelled"] = True
@@ -1015,6 +1043,8 @@ class CoreWorker:
             self._store_cancelled(spec, return_ids)
         finally:
             self._cancel_state.pop(spec["task_id"], None)
+            for oid in return_ids:
+                self._cancel_refs.pop(oid.hex(), None)
 
     async def _submit_and_track_inner(self, spec, resources, scheduling,
                                       max_retries, retry_exceptions,
@@ -1397,6 +1427,10 @@ class CoreWorker:
         from ray_tpu.util import tracing
         if tracing.enabled():
             call["trace"] = {"ctx": tracing.current_context()}
+        cst = {"cancelled": False, "actor": actor_id_hex}
+        self._cancel_state[task_id.hex()] = cst
+        for oid in return_ids:
+            self._cancel_refs[oid.hex()] = task_id.hex()
         # Fire-and-forget hand-off: call_soon_threadsafe + ensure_future is
         # ~2x cheaper per call than run_coroutine_threadsafe (no
         # concurrent.futures.Future or chain callback), and nothing reads
@@ -1416,6 +1450,9 @@ class CoreWorker:
                                                 return_ids, _retry)
         finally:
             if _retry == 0:
+                self._cancel_state.pop(call["call_id"], None)
+                for oid in return_ids:
+                    self._cancel_refs.pop(oid.hex(), None)
                 st["pending_calls"] -= 1
                 if st["kill_on_drain"] and st["pending_calls"] == 0:
                     st["kill_on_drain"] = False
@@ -1433,6 +1470,15 @@ class CoreWorker:
             # bounded budget — the method body never ran.
             for sys_attempt in range(11):
                 conn = await self._actor_conn(actor_id_hex, st)
+                # A cancel that raced connection establishment couldn't
+                # notify anyone — honor its flag before the call is ever
+                # delivered.
+                cst = self._cancel_state.get(call["call_id"])
+                if cst is not None and cst.get("cancelled"):
+                    self._store_cancelled(
+                        {"name": call["method"],
+                         "task_id": call["call_id"]}, return_ids)
+                    return
                 sent = dict(call)
                 sent["seq"] = st["seq"]
                 st["seq"] += 1

@@ -45,6 +45,11 @@ class TaskExecutor:
         self._current_task_id: str = None
         self._task_handle = None
         self._exec_started = False
+        # actor-call cancellation registry: call_id -> asyncio task;
+        # _sync_started marks bodies the exec THREAD has actually entered
+        # (a call parked in the pool queue is still cancellable).
+        self._actor_call_tasks: dict = {}
+        self._sync_started: set = set()
 
     def _cancel_task(self, msg: dict) -> dict:
         """Best-effort in-flight cancel (reference core_worker.cc
@@ -58,6 +63,13 @@ class TaskExecutor:
         see it on return to bytecode — same caveats as the reference.
         """
         tid = msg.get("task_id")
+        # actor calls: cancellable unless the sync body already runs
+        t = self._actor_call_tasks.get(tid)
+        if t is not None:
+            if tid in self._sync_started:
+                return {"ok": True, "not_cancellable": True}
+            t.cancel()
+            return {"ok": True}
         if self._current_task_id != tid:
             return {"ok": True, "not_running": True}
         if msg.get("force"):
@@ -258,6 +270,12 @@ class TaskExecutor:
             from ray_tpu.exceptions import ActorDiedError
             return {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
+        # Cancellable while queued / resolving args / awaiting an async
+        # method (reference: actor-task cancel covers exactly these; a
+        # sync method already on the exec thread is not interruptible
+        # without risking the actor's state).
+        call_id = msg["call_id"]
+        self._actor_call_tasks[call_id] = asyncio.current_task()
         t0 = time.time()
         status = "FINISHED"
         try:
@@ -300,13 +318,18 @@ class TaskExecutor:
                         result = await method(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
-                if tr is not None:
-                    def _call(m=method, a=args, k=kwargs):
+
+                # The exec thread marks the body as started on entry
+                # (GIL-atomic set add): once entered, cancellation would
+                # abandon in-progress actor state mutation, so
+                # _cancel_task refuses it (reference: only queued/async
+                # actor tasks cancel).
+                def _call(m=method, a=args, k=kwargs, _tr=tr):
+                    self._sync_started.add(call_id)
+                    if _tr is not None:
                         with tracing.span(name, _remote_parent=parent):
                             return m(*a, **k)
-                else:
-                    def _call(m=method, a=args, k=kwargs):
-                        return m(*a, **k)
+                    return m(*a, **k)
                 fut = loop.run_in_executor(self.core.exec_pool, _call)
                 self._advance(order, seq)
                 result = await fut
@@ -325,11 +348,30 @@ class TaskExecutor:
             from ray_tpu.exceptions import ActorDiedError
             return {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
+        except asyncio.CancelledError:
+            # ray_tpu.cancel() on this actor call while it was queued,
+            # resolving args, or awaiting an async method.  The order
+            # cursor MUST eventually step over this seq or every later
+            # call on the handle waits forever — but a QUEUED cancel may
+            # not leapfrog seqs that are still ahead of the cursor
+            # (advancing past them would unleash out-of-order execution).
+            status = "FAILED"
+            if order["next"] >= seq:
+                self._advance(order, seq)
+            else:
+                order.setdefault("skipped", set()).add(seq)
+            from ray_tpu import exceptions as rex
+            return {"ok": False, "cancelled": True,
+                    "error": _serialize_exception(rex.TaskCancelledError(
+                        f"actor call {msg['method']} "
+                        f"({call_id[:8]}) was cancelled"))}
         except Exception as e:  # noqa: BLE001
             status = "FAILED"
             self._advance(order, seq)
             return {"ok": False, "error": _serialize_exception(e)}
         finally:
+            self._actor_call_tasks.pop(call_id, None)
+            self._sync_started.discard(call_id)
             self.core.record_task_event({
                 "task_id": msg["call_id"], "name": msg["method"],
                 "kind": "actor_call", "actor_id": self.actor_id,
@@ -342,6 +384,12 @@ class TaskExecutor:
         # call even with nothing waiting (the hot path).
         if order["next"] <= seq:
             order["next"] = seq + 1
+        # Cascade over cancelled-while-queued seqs: they will never run,
+        # so the cursor must step through them or the line stalls.
+        skipped = order.get("skipped")
+        while skipped and order["next"] in skipped:
+            skipped.discard(order["next"])
+            order["next"] += 1
         nxt = order["next"]
         for s in [s for s in order["waiters"] if s <= nxt]:
             for f in order["waiters"].pop(s):

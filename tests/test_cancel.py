@@ -71,3 +71,65 @@ def test_cancel_finished_task_is_noop(cancel_cluster):
     # (or a late True if the record lingers) and get still succeeds.
     ray_tpu.cancel(ref)
     assert ray_tpu.get(ref, timeout=10) >= 0
+
+def test_cancel_async_actor_call(cancel_cluster):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        async def nap(self, seconds):
+            import asyncio
+            await asyncio.sleep(seconds)
+            return "woke"
+
+        def ping(self):
+            return "pong"
+
+    a = Sleeper.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.nap.remote(60)
+    time.sleep(1.0)
+    assert ray_tpu.cancel(ref)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 25
+    # actor survives and still serves
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+
+def test_cancel_queued_actor_call_preserves_order(cancel_cluster):
+    @ray_tpu.remote
+    class Worker:
+        def slow(self):
+            time.sleep(4)
+            return "slow-done"
+
+        def tagged(self, tag):
+            return tag
+
+    a = Worker.remote()
+    r_slow = a.slow.remote()
+    time.sleep(0.3)                     # slow() occupies the exec thread
+    r_victim = a.tagged.remote("victim")     # queued behind slow
+    r_after = a.tagged.remote("after")       # queued behind victim
+    assert ray_tpu.cancel(r_victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r_victim, timeout=30)
+    # earlier and later calls are untouched and IN ORDER
+    assert ray_tpu.get(r_slow, timeout=60) == "slow-done"
+    assert ray_tpu.get(r_after, timeout=30) == "after"
+
+
+def test_cancel_actor_force_raises(cancel_cluster):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            time.sleep(30)
+
+    a = A.remote()
+    ref = a.f.remote()
+    time.sleep(0.5)
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref, force=True)
+    # un-forced cancel of the RUNNING SYNC method is a no-op (reference:
+    # sync actor tasks aren't interruptible); the call completes.
+    ray_tpu.cancel(ref)
