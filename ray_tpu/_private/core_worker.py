@@ -165,8 +165,23 @@ class CoreWorker:
             self._run(self._async_shutdown(), timeout=5)
         except Exception:
             pass
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        # Detach the refcount sink BEFORE closing the loop: ObjectRef.__del__
+        # runs from arbitrary GC context and its is_closed() guard is
+        # check-then-act -- a ref collected mid-close would raise
+        # "Event loop is closed".
         object_ref_mod.set_refcount_sink(None)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        # Close the loop deterministically.  Leaving it for GC means
+        # BaseEventLoop.__del__ runs during interpreter teardown, after its
+        # self-pipe socket is already dead -> "Invalid file descriptor: -1"
+        # noise on every clean exit.
+        self._loop_thread.join(timeout=5)
+        if not self.loop.is_running():
+            try:
+                self.loop.close()
+            except Exception:
+                pass
+        self.exec_pool.shutdown(wait=False)
 
     async def _async_shutdown(self):
         await self.server.close()

@@ -1,0 +1,93 @@
+"""Safe JAX backend introspection for runtime plumbing.
+
+Rule: framework plumbing (daemons, shutdown hooks, usage reports, CLI
+status) must NEVER initialize a JAX backend as a side effect.  Backend
+init is expensive and, worse, *unbounded*: with a tunneled TPU whose
+link is down, ``jax.default_backend()`` blocks forever inside
+``make_c_api_client`` — there is no timeout to set.  The reference has
+the same discipline for GPUs: autodetection reads NVML/proc state and
+never blocks shutdown (``python/ray/_private/resource_spec.py:287``).
+
+On this class of machine a sitecustomize imports ``jax`` into every
+interpreter, so ``"jax" in sys.modules`` is NOT evidence that the user
+touched JAX — the only safe question is "is a backend *already*
+initialized?", answered by inspecting ``jax._src.xla_bridge._backends``
+(populated only by a successful ``get_backend()``).
+
+``probe_backend(timeout)`` is for the few places that genuinely want to
+*force* init (bench probes): it runs init in a daemon thread with a hard
+deadline so a dead tunnel costs ``timeout`` seconds, not forever.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+def initialized_backends() -> Dict[str, Any]:
+    """Backends that are ALREADY initialized (never triggers init).
+
+    Returns {} when jax isn't imported, has no initialized backend, or
+    its internals moved (we fail closed: claiming "no backend" is always
+    safe; cold-initializing one never is).
+    """
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    try:
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None)
+        return dict(backends) if backends else {}
+    except Exception:
+        return {}
+
+
+def backend_summary_if_initialized() -> Optional[Dict[str, Any]]:
+    """{"backend": name, "device_count": n} if a backend is live, else None.
+
+    Derived ONLY from the already-initialized snapshot.  Calling
+    ``jax.default_backend()`` here would be wrong even with backends
+    present: it takes ``xla_bridge._backend_lock``, and a wedged init on
+    another thread (e.g. an abandoned ``probe_backend`` with the tunnel
+    down) holds that lock forever — reintroducing the unbounded block
+    this module exists to prevent.
+    """
+    backends = initialized_backends()
+    if not backends:
+        return None
+    try:
+        # Mirror jax's platform priority (accelerator over cpu) without
+        # asking jax: prefer any non-cpu platform in the snapshot.
+        name = next((p for p in backends if p != "cpu"), None) \
+            or next(iter(backends))
+        return {"backend": name,
+                "device_count": backends[name].device_count()}
+    except Exception:
+        return None
+
+
+def probe_backend(timeout_s: float = 60.0) -> Optional[str]:
+    """Force backend init with a hard deadline; platform name or None.
+
+    The init runs in a daemon thread: if the device plugin wedges (tunnel
+    down), the thread is abandoned at the deadline and the caller moves
+    on.  CAVEAT: the abandoned thread still holds jax's _backend_lock, so
+    after a timed-out probe this PROCESS must not touch jax again (run
+    real work in a fresh subprocess).  Only use from explicit probes
+    (bench), never from runtime paths.
+    """
+    result: Dict[str, str] = {}
+
+    def _init() -> None:
+        try:
+            import jax
+            result["platform"] = jax.default_backend()
+        except Exception as e:  # noqa: BLE001 - report, don't raise in thread
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=_init, daemon=True, name="jax-backend-probe")
+    t.start()
+    t.join(timeout_s)
+    return result.get("platform")

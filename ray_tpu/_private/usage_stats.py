@@ -43,11 +43,14 @@ def usage_report() -> Dict[str, Any]:
     except Exception:
         pass
     try:
-        import sys
-        if "jax" in sys.modules:   # never cold-init a backend for a report
-            jax = sys.modules["jax"]
-            report["jax"] = {"backend": jax.default_backend(),
-                             "device_count": jax.device_count()}
+        # Report a backend only if one is ALREADY initialized.  A module
+        # check is not enough: sitecustomize may import jax into every
+        # interpreter, and cold-initing a backend here can block shutdown
+        # forever when the device link is down (see _private/jaxutil.py).
+        from ray_tpu._private.jaxutil import backend_summary_if_initialized
+        summary = backend_summary_if_initialized()
+        if summary is not None:
+            report["jax"] = summary
     except Exception:
         pass
     return report

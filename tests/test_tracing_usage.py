@@ -145,3 +145,42 @@ def test_actor_call_spans_join_trace(trace_cluster):
     actor_spans = [s for s in spans if s.get("trace_id") == trace_id
                    and s["name"] == "actor:work"]
     assert actor_spans[0]["parent_id"] == root_id
+
+
+def test_runtime_never_cold_inits_jax_backend(tmp_path):
+    """Framework plumbing must not initialize a JAX backend as a side effect.
+
+    Regression for the round-3 shutdown hang: usage_stats called
+    jax.default_backend() when "jax" was merely *imported* (sitecustomize
+    imports it everywhere), cold-initing the TPU backend at shutdown --
+    unbounded block when the device tunnel is down.  The invariant is
+    checkable without breaking the tunnel: after a full init/shutdown
+    round-trip, jax._src.xla_bridge._backends must still be empty.
+    """
+    import subprocess
+    import sys
+    env = dict(__import__("os").environ)
+    env["RT_LOG_DIR"] = str(tmp_path)
+    env.pop("JAX_PLATFORMS", None)  # do NOT pre-pin cpu; the point is no init
+    code = (
+        "import ray_tpu;"
+        "ray_tpu.init(num_cpus=1);"
+        "import ray_tpu._private.usage_stats as u;"
+        "u.usage_report();"
+        "ray_tpu.shutdown();"
+        "from jax._src import xla_bridge as xb;"
+        "assert not xb._backends, ('backend cold-inited: %r' % xb._backends)")
+    subprocess.run([sys.executable, "-c", code], env=env, check=True,
+                   timeout=120)
+
+
+def test_backend_summary_never_inits():
+    from ray_tpu._private.jaxutil import (backend_summary_if_initialized,
+                                          initialized_backends)
+    from jax._src import xla_bridge as xb
+    before = dict(xb._backends)
+    summary = backend_summary_if_initialized()
+    assert dict(xb._backends) == before     # no side effect
+    if not before:
+        assert summary is None
+    assert initialized_backends() == before
