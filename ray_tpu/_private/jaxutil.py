@@ -14,14 +14,14 @@ touched JAX — the only safe question is "is a backend *already*
 initialized?", answered by inspecting ``jax._src.xla_bridge._backends``
 (populated only by a successful ``get_backend()``).
 
-``probe_backend(timeout)`` is for the few places that genuinely want to
-*force* init (bench probes): it runs init in a daemon thread with a hard
-deadline so a dead tunnel costs ``timeout`` seconds, not forever.
+Code that genuinely wants to *force* init (bench probes) must do it in a
+throwaway SUBPROCESS with a timeout (see bench.py) — an in-process probe
+thread that wedges would leave ``_backend_lock`` held forever, poisoning
+every later jax call in the process.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
 
@@ -68,26 +68,3 @@ def backend_summary_if_initialized() -> Optional[Dict[str, Any]]:
         return None
 
 
-def probe_backend(timeout_s: float = 60.0) -> Optional[str]:
-    """Force backend init with a hard deadline; platform name or None.
-
-    The init runs in a daemon thread: if the device plugin wedges (tunnel
-    down), the thread is abandoned at the deadline and the caller moves
-    on.  CAVEAT: the abandoned thread still holds jax's _backend_lock, so
-    after a timed-out probe this PROCESS must not touch jax again (run
-    real work in a fresh subprocess).  Only use from explicit probes
-    (bench), never from runtime paths.
-    """
-    result: Dict[str, str] = {}
-
-    def _init() -> None:
-        try:
-            import jax
-            result["platform"] = jax.default_backend()
-        except Exception as e:  # noqa: BLE001 - report, don't raise in thread
-            result["error"] = repr(e)
-
-    t = threading.Thread(target=_init, daemon=True, name="jax-backend-probe")
-    t.start()
-    t.join(timeout_s)
-    return result.get("platform")

