@@ -28,12 +28,12 @@ Key protocol choices mirroring the reference:
 from __future__ import annotations
 
 import asyncio
+import functools
 import hashlib
 import logging
 import threading
 import time
 import traceback
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -68,6 +68,66 @@ def _serialize_exception(e: BaseException) -> bytes:
         payload = cloudpickle.dumps(
             (RuntimeError(f"{type(e).__name__}: {e} (original unpicklable)"), tb))
     return payload
+
+
+class ExecChannel:
+    """Single dedicated execution thread (actor serial semantics) with the
+    minimum per-item machinery: a SimpleQueue hand-off in, one
+    call_soon_threadsafe back.  Replaces ThreadPoolExecutor, whose
+    submit() builds a concurrent Future (lock + condition) and a chained
+    callback per item — ~40us/call of pure overhead on the actor hot path
+    (reference analog: the dedicated task-execution thread in the
+    Cython worker loop, ``_raylet.pyx execute_task``)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        import queue
+        self._loop = loop
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        t = threading.Thread(target=self._main, daemon=True, name="rt-exec")
+        self._threads = [t]          # same shape as ThreadPoolExecutor's
+        t.start()
+
+    def _main(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            if fut.cancelled():
+                # Cancelled while queued (ray_tpu.cancel on a parked actor
+                # call): the body must not run.  Reading the flag off-loop
+                # is GIL-safe; a cancel landing after this check races the
+                # body exactly as ThreadPoolExecutor's did.
+                continue
+            try:
+                ok, res = True, fn()
+            except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
+                ok, res = False, e
+            try:
+                self._loop.call_soon_threadsafe(self._finish, fut, ok, res)
+            except RuntimeError:
+                return               # loop closed mid-shutdown
+
+    @staticmethod
+    def _finish(fut: asyncio.Future, ok: bool, res) -> None:
+        if fut.cancelled():
+            return
+        if ok:
+            fut.set_result(res)
+        else:
+            fut.set_exception(res)
+
+    def run(self, fn) -> asyncio.Future:
+        """Schedule fn on the exec thread; await the returned future.
+        Loop-thread callers only (the future belongs to the loop)."""
+        fut = self._loop.create_future()
+        self._q.put((fut, fn))
+        return fut
+
+    def shutdown(self, wait: bool = False) -> None:
+        self._q.put(None)
+        if wait:
+            self._threads[0].join(timeout=5)
 
 
 class CoreWorker:
@@ -126,6 +186,11 @@ class CoreWorker:
         # executor hooks, set by worker_main on workers
         self.task_executor = None
 
+        # Actor-call submission coalescing (one loop wakeup per burst).
+        self._submit_queue: list = []
+        self._submit_lock = threading.Lock()
+        self._submit_scheduled = False
+
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._loop_main,
                                              name="rt-io", daemon=True)
@@ -133,8 +198,7 @@ class CoreWorker:
         self._loop_thread.start()
         self._started.wait()
 
-        self.exec_pool = ThreadPoolExecutor(max_workers=1,
-                                            thread_name_prefix="rt-exec")
+        self.exec_pool = ExecChannel(self.loop)
         self._run(self._async_init())
         object_ref_mod.set_refcount_sink(self)
 
@@ -494,14 +558,37 @@ class CoreWorker:
 
     async def get_objects_async(self, refs: List[ObjectRef],
                                 timeout: Optional[float] = None):
-        coros = [self.get_async(r) for r in refs]
         try:
             if timeout is None:
-                return list(await asyncio.gather(*coros))
-            return list(await asyncio.wait_for(asyncio.gather(*coros), timeout))
+                return await self._get_objects(refs)
+            return await asyncio.wait_for(self._get_objects(refs), timeout)
         except asyncio.TimeoutError:
             raise rex.GetTimeoutError(
                 f"get() timed out after {timeout}s") from None
+
+    async def _get_objects(self, refs: List[ObjectRef]):
+        # Remote-owned refs need their pulls IN FLIGHT concurrently (a
+        # gather task each); self-owned refs resolve passively — their
+        # values land in the local memory store regardless of who waits —
+        # so awaiting them sequentially is equivalent and skips a task +
+        # future per ref (the actor-call fan-in hot path: get() on many
+        # returns of calls this process submitted).
+        out = [None] * len(refs)
+        local_idx = []
+        remote = []
+        for i, r in enumerate(refs):
+            if r.owner_address and r.owner_address != self.address:
+                remote.append(self._fill_get(out, i, r))
+            else:
+                local_idx.append(i)
+        if remote:
+            await asyncio.gather(*remote)
+        for i in local_idx:
+            out[i] = await self.get_async(refs[i])
+        return out
+
+    async def _fill_get(self, out: list, i: int, ref: ObjectRef):
+        out[i] = await self.get_async(ref)
 
     async def get_async(self, ref: ObjectRef) -> Any:
         data = await self._resolve_bytes(ref.id, ref.owner_address)
@@ -642,7 +729,7 @@ class CoreWorker:
                                                 rec["scheduling"])
                 ok = bool(reply.get("ok"))
                 if ok:
-                    await self._store_task_returns(reply, rec["return_ids"])
+                    self._store_task_returns(reply, rec["return_ids"])
             except Exception:
                 ok = False
             fut.set_result(ok)
@@ -1093,7 +1180,7 @@ class CoreWorker:
                 last_err = e
                 break
             if reply.get("ok"):
-                await self._store_task_returns(reply, return_ids)
+                self._store_task_returns(reply, return_ids)
                 return
             if reply.get("cancelled"):
                 for oid in return_ids:
@@ -1344,7 +1431,9 @@ class CoreWorker:
             except Exception:
                 pass
 
-    async def _store_task_returns(self, reply: dict, return_ids):
+    def _store_task_returns(self, reply: dict, return_ids):
+        # Fully synchronous on purpose: the batch-reply path runs it from a
+        # future done-callback, where no task exists to await anything.
         for (oid_hex, kind, data), oid in zip(reply["returns"], return_ids):
             if oid_hex not in self.owned:
                 continue  # freed while the task (or a reconstruction) ran
@@ -1446,14 +1535,137 @@ class CoreWorker:
         self._cancel_state[task_id.hex()] = cst
         for oid in return_ids:
             self._cancel_refs[oid.hex()] = task_id.hex()
-        # Fire-and-forget hand-off: call_soon_threadsafe + ensure_future is
-        # ~2x cheaper per call than run_coroutine_threadsafe (no
-        # concurrent.futures.Future or chain callback), and nothing reads
-        # the submission's result here — outcomes land in the memory store.
-        coro = self._submit_actor_call(actor_id_hex, call, return_ids,
-                                       pinned_args=pinned_args)
-        self.loop.call_soon_threadsafe(asyncio.ensure_future, coro)
+        # Coalesced hand-off: submissions queue on the caller thread and a
+        # single call_soon_threadsafe per burst flushes them — one loop
+        # wakeup (one self-pipe syscall) and one task per (actor, burst)
+        # instead of per call.  Same-tick calls to one actor then ride a
+        # single _BATCH frame (reference analog: direct actor transport
+        # batching, src/ray/core_worker/transport/direct_actor_transport.cc).
+        with self._submit_lock:
+            self._submit_queue.append(
+                (actor_id_hex, call, return_ids, pinned_args))
+            wake = not self._submit_scheduled
+            self._submit_scheduled = True
+        if wake:
+            self.loop.call_soon_threadsafe(self._flush_submits)
         return refs
+
+    def _flush_submits(self):
+        """Loop-side: drain the submit queue, one task per actor group."""
+        with self._submit_lock:
+            batch, self._submit_queue = self._submit_queue, []
+            self._submit_scheduled = False
+        groups: Dict[str, list] = {}
+        for entry in batch:
+            groups.setdefault(entry[0], []).append(entry)
+        for actor_id_hex, entries in groups.items():
+            asyncio.ensure_future(
+                self._submit_actor_group(actor_id_hex, entries))
+
+    async def _submit_actor_group(self, actor_id_hex: str, entries: list):
+        """Send a burst of same-actor calls as one _BATCH frame.
+
+        Replies resolve per call via done-callbacks (no per-call task);
+        rare outcomes (retriable reply, connection loss) fall back to the
+        per-call `_submit_actor_call` slow path with batch-side accounting.
+        """
+        st = self._actor(actor_id_hex)
+        st["pending_calls"] += len(entries)
+        try:
+            conn = await self._actor_conn(actor_id_hex, st)
+        except Exception as e:  # noqa: BLE001 - actor dead/unknown
+            err = (e if isinstance(e, rex.ActorDiedError)
+                   else rex.ActorDiedError(str(e)))
+            payload = cloudpickle.dumps((err, ""))
+            for _, call, return_ids, _pin in entries:
+                for oid in return_ids:
+                    self._store_local(oid.hex(), "err", payload)
+                self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+            return
+        msgs, metas = [], []
+        for _, call, return_ids, pinned in entries:
+            cst = self._cancel_state.get(call["call_id"])
+            if cst is not None and cst.get("cancelled"):
+                self._store_cancelled(
+                    {"name": call["method"], "task_id": call["call_id"]},
+                    return_ids)
+                self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+                continue
+            sent = dict(call)
+            sent["seq"] = st["seq"]
+            st["seq"] += 1
+            msgs.append(sent)
+            metas.append((call, return_ids, pinned))
+        if not msgs:
+            return
+        try:
+            futs = conn.request_batch(msgs)
+        except Exception:   # connection died between dial and send
+            for call, return_ids, pin in metas:
+                asyncio.ensure_future(self._group_fallback(
+                    st, actor_id_hex, call, return_ids, pinned=pin))
+            return
+        for fut, meta in zip(futs, metas):
+            fut.add_done_callback(functools.partial(
+                self._on_actor_reply, st, actor_id_hex, meta))
+        await conn.maybe_drain()   # backpressure: bound the send buffer
+
+    def _on_actor_reply(self, st, actor_id_hex, meta, fut):
+        """Future done-callback on the IO loop: terminal outcomes store
+        synchronously; non-terminal ones re-enter the slow path."""
+        call, return_ids, pinned = meta
+        try:
+            reply = fut.result()
+        except (ConnectionLost, asyncio.CancelledError):
+            st["conn"] = None
+            st["address"] = None
+            asyncio.ensure_future(self._group_fallback(
+                st, actor_id_hex, call, return_ids, pinned=pinned))
+            return
+        except Exception as e:  # noqa: BLE001
+            payload = cloudpickle.dumps((e, traceback.format_exc()))
+            for oid in return_ids:
+                self._store_local(oid.hex(), "err", payload)
+            self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+            return
+        if reply.get("retriable"):
+            asyncio.ensure_future(self._group_fallback(
+                st, actor_id_hex, call, return_ids, retriable=True,
+                pinned=pinned))
+            return
+        if reply.get("ok"):
+            self._store_task_returns(reply, return_ids)
+        else:
+            for oid in return_ids:
+                self._store_local(oid.hex(), "err", reply["error"])
+        self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+
+    async def _group_fallback(self, st, actor_id_hex, call, return_ids,
+                              retriable=False, pinned=None):
+        """Batch-path escape hatch: re-drive one call through the per-call
+        submit loop (fresh seq; its own retry budget).  _retry=1 keeps the
+        per-call path from double-counting pending_calls/cancel state —
+        this wrapper owns the batch-side accounting.  ``pinned`` is held
+        in this frame so ObjectRef args stay alive across the retry (the
+        batch meta tuple that pinned them dies with its done-callback)."""
+        try:
+            if retriable:
+                await asyncio.sleep(2.0)   # mirror the per-call backoff
+            await self._submit_actor_call(actor_id_hex, call, return_ids,
+                                          _retry=1)
+        finally:
+            self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+
+    def _finish_actor_entry(self, st, actor_id_hex, call, return_ids):
+        self._cancel_state.pop(call["call_id"], None)
+        for oid in return_ids:
+            self._cancel_refs.pop(oid.hex(), None)
+        st["pending_calls"] -= 1
+        if st["kill_on_drain"] and st["pending_calls"] == 0:
+            st["kill_on_drain"] = False
+            asyncio.ensure_future(self.gcs.notify(
+                {"type": "kill_actor", "actor_id": actor_id_hex,
+                 "no_restart": True}))
 
     async def _submit_actor_call(self, actor_id_hex, call, return_ids,
                                  _retry: int = 0, pinned_args=None):
@@ -1508,7 +1720,7 @@ class CoreWorker:
                     continue
                 break
             if reply.get("ok"):
-                await self._store_task_returns(reply, return_ids)
+                self._store_task_returns(reply, return_ids)
             else:
                 for oid in return_ids:
                     self._store_local(oid.hex(), "err", reply["error"])

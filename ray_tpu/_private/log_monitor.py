@@ -48,6 +48,10 @@ class _Stream:
     actor_id: Optional[str] = None
     job_id: Optional[str] = None
     pos: int = 0                 # first byte not yet emitted
+    # Set after emitting the truncated head of an oversized line: drop
+    # bytes up to the next newline so the line's remainder is not misread
+    # as fresh lines on later polls.
+    skip_to_newline: bool = False
 
 
 @dataclass
@@ -110,21 +114,43 @@ class LogMonitor:
             return False
         if not data:
             return False
+        if s.skip_to_newline:
+            # Discarding the remainder of a previously-truncated line.
+            nl = data.find(b"\n")
+            if nl < 0:
+                s.pos += len(data)
+                return False
+            s.pos += nl + 1
+            data = data[nl + 1:]
+            s.skip_to_newline = False
+            if not data:
+                return False
         lines = data.split(b"\n")
         tail = lines.pop()  # incomplete trailing line (or b"")
+        truncated_tail = None
         if len(lines) > MAX_LINES_PER_POLL:
             lines = lines[:MAX_LINES_PER_POLL]
             s.pos += sum(len(ln) + 1 for ln in lines)
-        elif len(tail) > MAX_LINE_LEN or (not lines and len(data) == READ_CAP):
-            # A single oversized line with no newline yet: emit a truncated
-            # chunk and move on, or we would re-read it forever.
-            lines.append(tail[:MAX_LINE_LEN])
+        elif not lines and (len(tail) > MAX_LINE_LEN
+                            or len(data) == READ_CAP):
+            # A single oversized line with no newline yet: emit its head
+            # with an explicit truncation marker (dropped bytes must be
+            # visible) and skip the rest up to the next newline.
+            truncated_tail = (tail[:MAX_LINE_LEN].decode("utf-8", "replace")
+                              + " ...[truncated: line exceeded "
+                              f"{MAX_LINE_LEN} bytes]")
             s.pos += len(data)
+            s.skip_to_newline = True
         else:
+            # Oversized-but-accompanied tails wait here too: the complete
+            # lines go out now, the tail is re-read next poll and takes
+            # the lone-oversized path above if it still has no newline.
             s.pos += len(data) - len(tail)
-        if not lines:
+        if not lines and truncated_tail is None:
             return False
         out = [ln[:MAX_LINE_LEN].decode("utf-8", "replace") for ln in lines]
+        if truncated_tail is not None:
+            out.append(truncated_tail)
         try:
             await self.publish({
                 "node_id": self.node_id,

@@ -69,12 +69,22 @@ class SerializationContext:
 
     # -- serialize --
 
+    # Exact builtin scalar types take the C pickler directly: a cloudpickle
+    # dumps() builds a CloudPickler per call (~15us); plain pickle is
+    # sub-microsecond.  Only EXACT types — subclasses or containers may
+    # reach objects that need cloudpickle's reducers (closures, jax
+    # arrays), so they keep the general path.
+    _PLAIN_TYPES = (type(None), bool, int, float, str, bytes)
+
     def serialize(self, value: Any) -> SerializedObject:
         self._maybe_register_jax()
         buffers: List[pickle.PickleBuffer] = []
-        payload = cloudpickle.dumps(
-            value, protocol=5, buffer_callback=buffers.append
-        )
+        if type(value) in self._PLAIN_TYPES:
+            payload = pickle.dumps(value, protocol=5)
+        else:
+            payload = cloudpickle.dumps(
+                value, protocol=5, buffer_callback=buffers.append
+            )
         raws = [b.raw() for b in buffers]
         header = _HEAD.pack(_MAGIC, len(raws))
         lens = struct.pack(f"<{len(raws) + 1}Q", len(payload), *[r.nbytes for r in raws])

@@ -160,8 +160,7 @@ class TaskExecutor:
             else:
                 run = lambda: fn(*args, **kwargs)  # noqa: E731
             try:
-                result = await loop.run_in_executor(
-                    self.core.exec_pool, run)
+                result = await self.core.exec_pool.run(run)
             except (KeyboardInterrupt, asyncio.CancelledError):
                 # ray_tpu.cancel(): either the injected thread interrupt
                 # or (pre-execution) this asyncio task's cancellation.
@@ -246,8 +245,8 @@ class TaskExecutor:
             self._sem = asyncio.Semaphore(self.max_concurrency)
             self.actor_id = msg["actor_id"]
             loop = asyncio.get_running_loop()
-            self.actor_instance = await loop.run_in_executor(
-                self.core.exec_pool, lambda: cls(*args, **kwargs))
+            self.actor_instance = await self.core.exec_pool.run(
+                lambda: cls(*args, **kwargs))
             await self.core.flush_borrow_acks()
             title = getattr(cls, "__name__", "Actor")
             _set_proc_title(f"ray_tpu::actor::{title}")
@@ -330,7 +329,7 @@ class TaskExecutor:
                         with tracing.span(name, _remote_parent=parent):
                             return m(*a, **k)
                     return m(*a, **k)
-                fut = loop.run_in_executor(self.core.exec_pool, _call)
+                fut = self.core.exec_pool.run(_call)
                 self._advance(order, seq)
                 result = await fut
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],

@@ -55,7 +55,7 @@ _SCR = 128     # lane width of VMEM scratch accumulators
 _TUNED: dict = {}
 
 
-def _default_blocks(S: int, H: int) -> tuple:
+def _default_blocks(S: int, H: int, strict: bool = True) -> tuple:
     """Heuristic block sizes: large blocks amortize the K/V stream and the
     grid launch; 128-lane alignment keeps the MXU full.  Overridable via
     RT_FLASH_BLOCK_Q / RT_FLASH_BLOCK_K or per-call arguments."""
@@ -64,13 +64,22 @@ def _default_blocks(S: int, H: int) -> tuple:
     # and larger tiles amortize the grid/DMA overhead.
     bq = int(os.environ.get("RT_FLASH_BLOCK_Q", 0)) or 1024
     bk = int(os.environ.get("RT_FLASH_BLOCK_K", 0)) or 1024
-    # Halve until the block divides S (terminates at 1, which always
-    # divides — Mosaic itself rejects sub-tile blocks on TPU, so odd S
-    # values that can't reach a >=8 block need padding by the caller).
+    # Halve until the block divides S.  Mosaic rejects sub-tile (<8)
+    # blocks on real TPU with an opaque compile error, so fail loudly
+    # here instead: sequence lengths with small odd factors must be
+    # padded by the caller.
     while S % bq:
         bq //= 2
     while S % bk:
         bk //= 2
+    if strict and (bq < 8 or bk < 8):
+        # strict=False (interpret mode) permits sub-tile blocks: the
+        # interpreter has no Mosaic tiling constraint.
+        raise ValueError(
+            f"flash_attention: sequence length {S} only admits block sizes "
+            f"({bq}, {bk}) < 8, which the TPU compiler rejects. Pad the "
+            f"sequence to a multiple of 8 (ideally 128) or pass explicit "
+            f"block_q/block_k that divide it.")
     return bq, bk
 
 
@@ -408,7 +417,8 @@ def _resolve(q, causal, block_q, block_k, interpret, layout):
         else:
             B, S, N, H = q.shape
         key = (jax.default_backend(), B, S, N, H, str(q.dtype), causal)
-        bq, bk = _TUNED.get(key) or _default_blocks(S, H)
+        bq, bk = _TUNED.get(key) or _default_blocks(S, H,
+                                                    strict=not interpret)
         block_q = block_q or bq
         block_k = block_k or bk
     return block_q, block_k, interpret

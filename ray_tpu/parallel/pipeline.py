@@ -152,8 +152,16 @@ def gpipe_fused_loss_spmd(block_fn: Callable, loss_mb_fn: Callable,
         m = t - (pp - 1)
         tgt = jax.lax.dynamic_index_in_dim(
             tgt_mbs, jnp.clip(m, 0, M - 1), 0, keepdims=False)
-        ll = loss_mb_fn(head_params, y, tgt)
-        ll_acc = ll_acc + jnp.where((idx == pp - 1) & (m >= 0), ll, 0.0)
+        # Gate the head (LM-head matmul + CE, the priciest op here at real
+        # vocab sizes) so only the last stage pays it: under shard_map the
+        # predicate is a per-device scalar, so lax.cond lowers to a real
+        # branch and non-final stages skip the FLOPs instead of computing
+        # and discarding through a where-mask.
+        ll = jax.lax.cond(
+            (idx == pp - 1) & (m >= 0),
+            lambda: loss_mb_fn(head_params, y, tgt).astype(jnp.float32),
+            lambda: jnp.zeros((), jnp.float32))
+        ll_acc = ll_acc + ll
         state = jax.lax.ppermute(y, axis_name, shift)
         return (state, ll_acc, aux_acc), None
 

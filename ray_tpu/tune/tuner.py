@@ -40,6 +40,7 @@ def _mirror_dir(uri: str, fresh: bool = False) -> str:
     Keyed by (uri, pid) so concurrent same-URI runs on one machine don't
     interleave writes; ``fresh=True`` wipes any leftover state first (a new
     run must not inherit a previous experiment's files)."""
+    import atexit
     import hashlib
     import shutil
     import tempfile
@@ -49,6 +50,10 @@ def _mirror_dir(uri: str, fresh: bool = False) -> str:
     if fresh:
         shutil.rmtree(d, ignore_errors=True)
     os.makedirs(d, exist_ok=True)
+    # The mirror is a full experiment copy; reap it at interpreter exit so
+    # repeated URI-storage runs don't accumulate copies in /tmp (same
+    # pattern as Checkpoint.from_uri's download dirs).
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
     return d
 
 

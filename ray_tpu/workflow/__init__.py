@@ -6,11 +6,14 @@ step's output checkpointed to storage, so a crashed run resumes from the
 last completed step with exactly-once step execution.
 """
 
-from ray_tpu.workflow.api import (get_output, get_status, init, list_all,
-                                  resume, run, run_async)
+from ray_tpu.workflow.api import (WorkflowCancelledError, cancel, event,
+                                  get_output, get_status, init, list_all,
+                                  resume, resume_all, run, run_async,
+                                  send_event)
 
-__all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all"]
+__all__ = ["init", "run", "run_async", "resume", "resume_all", "cancel",
+           "event", "send_event", "get_output", "get_status", "list_all",
+           "WorkflowCancelledError"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu("workflow")

@@ -24,7 +24,7 @@ def test_load_suite_parses_entries():
     mb = next(t for t in tests if t["name"] == "microbenchmark")
     assert "smoke" in mb["suite"]
     assert mb["timeout_s"] == 420
-    assert mb["success_criteria"]["1_1_actor_calls_sync"]["min"] == 1000
+    assert mb["success_criteria"]["1_1_actor_calls_sync"]["min"] == 1500
 
 
 def test_run_test_evaluates_criteria(tmp_path):
