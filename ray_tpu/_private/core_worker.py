@@ -1677,15 +1677,7 @@ class CoreWorker:
                                                 return_ids, _retry)
         finally:
             if _retry == 0:
-                self._cancel_state.pop(call["call_id"], None)
-                for oid in return_ids:
-                    self._cancel_refs.pop(oid.hex(), None)
-                st["pending_calls"] -= 1
-                if st["kill_on_drain"] and st["pending_calls"] == 0:
-                    st["kill_on_drain"] = False
-                    await self.gcs.notify({"type": "kill_actor",
-                                           "actor_id": actor_id_hex,
-                                           "no_restart": True})
+                self._finish_actor_entry(st, actor_id_hex, call, return_ids)
 
     async def _submit_actor_call_inner(self, actor_id_hex, st, call,
                                        return_ids, _retry):

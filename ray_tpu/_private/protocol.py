@@ -155,14 +155,21 @@ class RpcConnection:
             self._write_frame_nowait(payload)
         except Exception:
             # One unpicklable message must not poison the batch: retry
-            # per-message, losing only the offender (same contract as the
-            # old per-frame path, where its reply was silently dropped).
+            # per-message.  A dropped REQUEST must fail its caller's
+            # pending future (it would otherwise await forever on a live
+            # connection); a dropped reply is logged, as before.
             for item in ob:
                 try:
                     self._write_frame_nowait(pickle.dumps(item, protocol=5))
-                except Exception:
-                    logger.exception(
-                        "dropping unpicklable message on %s", self.name)
+                except Exception as e:
+                    kind, rid, _msg = item
+                    if kind == _REQUEST:
+                        fut = self._pending.pop(rid, None)
+                        if fut is not None and not fut.done():
+                            fut.set_exception(e)
+                    else:
+                        logger.exception(
+                            "dropping unpicklable message on %s", self.name)
 
     def request_batch(self, msgs) -> "list[asyncio.Future]":
         """Register N requests and queue them on the outbox; returns their

@@ -10,8 +10,13 @@ from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                Space, VectorEnv, make_vector_env,
                                register_env)
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
+from ray_tpu.rllib.offline import (BC, BCConfig, BCPolicy, CQL, CQLConfig,
+                                   DatasetReader, DatasetWriter,
+                                   ImportanceSamplingEstimator)
 from ray_tpu.rllib.policy import Policy, PPOPolicy, compute_gae
-from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.ppo import (PPO, PPOConfig, RecurrentPPO,
+                               RecurrentPPOConfig)
+from ray_tpu.rllib.recurrent import RecurrentPPOPolicy
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.multi_agent import (CoordinationGameEnv, MultiAgentBatch,
@@ -23,12 +28,15 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "CartPoleVectorEnv", "DQN",
-    "DQNConfig", "DQNPolicy", "Env", "Impala", "ImpalaConfig",
-    "ImpalaPolicy", "PendulumVectorEnv", "Policy", "PPO", "PPOConfig",
-    "PPOPolicy", "PrioritizedReplayBuffer", "ReplayBuffer",
-    "RolloutWorker", "SampleBatch", "Space", "VectorEnv", "WorkerSet",
-    "compute_gae", "make_vector_env", "register_env",
+    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "BCPolicy",
+    "CartPoleVectorEnv", "CQL", "CQLConfig", "DatasetReader",
+    "DatasetWriter", "DQN", "DQNConfig", "DQNPolicy", "Env", "Impala",
+    "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",
+    "PendulumVectorEnv", "Policy", "PPO", "PPOConfig", "PPOPolicy",
+    "PrioritizedReplayBuffer", "RecurrentPPO", "RecurrentPPOConfig",
+    "RecurrentPPOPolicy", "ReplayBuffer", "RolloutWorker", "SampleBatch",
+    "Space", "VectorEnv", "WorkerSet", "compute_gae", "make_vector_env",
+    "register_env",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

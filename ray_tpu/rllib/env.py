@@ -197,9 +197,69 @@ class PendulumVectorEnv(VectorEnv):
         return self._obs(), (-cost).astype(np.float32), done, info
 
 
+class RepeatPreviousVectorEnv(VectorEnv):
+    """Memory probe: emit the token seen on the PREVIOUS step.
+
+    Observation is a one-hot token drawn uniformly each step; reward 1.0
+    when the action equals the token shown one step earlier (0 on the
+    first step of an episode).  A memoryless policy peaks at 1/n_tokens
+    expected reward per step; a recurrent policy solves it exactly — the
+    standard smoke test for whether hidden state actually carries
+    information (reference analog: RepeatAfterMeEnv in
+    rllib/examples/envs/classes/repeat_after_me_env.py — behavior
+    re-derived, not ported).
+    """
+
+    def __init__(self, num_envs: int = 1, n_tokens: int = 3,
+                 episode_len: int = 32, seed: int = 0):
+        super().__init__(num_envs)
+        self.n_tokens = n_tokens
+        self.episode_len = episode_len
+        self.observation_space = Space("box", shape=(n_tokens,))
+        self.action_space = Space("discrete", n=n_tokens)
+        self._rng = np.random.default_rng(seed)
+        self._token = np.zeros((num_envs,), np.int64)
+        self._prev = np.zeros((num_envs,), np.int64)
+        self._steps = np.zeros((num_envs,), np.int64)
+
+    def _one_hot(self) -> np.ndarray:
+        out = np.zeros((self.num_envs, self.n_tokens), np.float32)
+        out[np.arange(self.num_envs), self._token] = 1.0
+        return out
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._token = self._rng.integers(0, self.n_tokens,
+                                         size=self.num_envs)
+        self._prev[:] = -1          # no reward defined for the first step
+        self._steps[:] = 0
+        return self._one_hot()
+
+    def vector_step(self, actions: np.ndarray):
+        actions = np.asarray(actions)
+        reward = (actions == self._prev).astype(np.float32)
+        reward[self._prev < 0] = 0.0
+        self._prev = self._token.copy()
+        self._token = self._rng.integers(0, self.n_tokens,
+                                         size=self.num_envs)
+        self._steps += 1
+        truncated = self._steps >= self.episode_len
+        done = truncated.copy()
+        info = {"terminal_obs": self._one_hot(), "truncated": truncated}
+        if done.any():
+            idx = np.nonzero(done)[0]
+            self._prev[idx] = -1
+            self._steps[idx] = 0
+            self._token[idx] = self._rng.integers(0, self.n_tokens,
+                                                  size=len(idx))
+        return self._one_hot(), reward, done, info
+
+
 _ENV_REGISTRY = {
     "CartPole-v1": CartPoleVectorEnv,
     "Pendulum-v1": PendulumVectorEnv,
+    "RepeatPrevious-v0": RepeatPreviousVectorEnv,
 }
 
 

@@ -42,3 +42,29 @@ class PPO(Algorithm):
         return {"info": {"learner": stats},
                 "train_batch_size": train_batch.count,
                 **{f"learner_{k}": v for k, v in stats.items()}}
+
+
+class RecurrentPPOConfig(PPOConfig):
+    """PPO with an LSTM core (see rllib/recurrent.py) for POMDP/memory
+    tasks.  Reference analog: PPOConfig().training(model={"use_lstm":
+    True}) routing through rllib/models/torch/recurrent_net.py.
+    Fragments are time-major per worker; sample with the local worker
+    (num_rollout_workers=0) — cross-worker fragment concat is not wired.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._config.update({
+            "policy": "recurrent_ppo",
+            "lstm_cell_size": 64,
+            "lstm_embed": 64,
+            "num_rollout_workers": 0,
+        })
+        self.algo_class = RecurrentPPO
+
+
+class RecurrentPPO(PPO):
+    def __init__(self, config=None, **kwargs):
+        config = dict(config or {})
+        config.setdefault("policy", "recurrent_ppo")
+        super().__init__(config=config, **kwargs)

@@ -1,0 +1,223 @@
+"""Recurrent (LSTM) policy support for PPO.
+
+Design analog: reference ``rllib/models/torch/recurrent_net.py``
+(LSTMWrapper: obs embed -> LSTM -> pi/vf heads) and the sequence-aware
+PPO loss in ``torch_policy_v2.py`` (time-major forward with per-episode
+state resets).  TPU-first deltas: the network is a pure pytree, the
+sequence forward is a ``lax.scan`` over time (static shapes, one fused
+program), and the whole PPO update — epochs included — is a single
+jitted call, so fragment training costs one dispatch.
+
+State plumbing mirrors the reference's sampler contract: the rollout
+worker snapshots the hidden state at fragment start (``state_in``),
+carries it across steps, and zeroes finished envs' rows; the learner
+replays the same resets inside the scan via the shifted ``dones`` mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.policy import (Categorical, DiagGaussian, Policy,
+                                  _orthogonal)
+from ray_tpu.rllib.sample_batch import (ACTIONS, ACTION_LOGP, ADVANTAGES,
+                                        DONES, OBS, VALUE_TARGETS, VF_PREDS)
+
+STATE_IN = "state_in"       # [n, 2, H] fragment-start LSTM state
+RESETS = "resets"           # [T, n] 1.0 where state must zero BEFORE step t
+
+
+# -- LSTM actor-critic ----------------------------------------------------
+
+def lstm_init(rng: jax.Array, obs_dim: int, num_outputs: int,
+              embed: int = 64, hidden: int = 64,
+              head_scale: float = 0.01) -> Dict:
+    k = jax.random.split(rng, 5)
+    return {
+        "embed": {"w": _orthogonal(k[0], (obs_dim, embed), jnp.sqrt(2.0)),
+                  "b": jnp.zeros((embed,))},
+        # One fused kernel for the 4 gates (i, f, g, o): [E+H, 4H].
+        "lstm": {"w": _orthogonal(k[1], (embed + hidden, 4 * hidden), 1.0),
+                 "b": jnp.zeros((4 * hidden,))},
+        "pi": {"w": _orthogonal(k[2], (hidden, num_outputs), head_scale),
+               "b": jnp.zeros((num_outputs,))},
+        "vf": {"w": _orthogonal(k[3], (hidden, 1), 1.0),
+               "b": jnp.zeros((1,))},
+    }
+
+
+def _lstm_cell(params, h, c, x):
+    z = jnp.concatenate([x, h], axis=-1) @ params["lstm"]["w"] \
+        + params["lstm"]["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def lstm_step(params: Dict, state: jax.Array, obs: jax.Array
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One timestep: state [n, 2, H], obs [n, D] -> (pi, v, new_state)."""
+    x = jnp.tanh(obs @ params["embed"]["w"] + params["embed"]["b"])
+    h, c = state[:, 0], state[:, 1]
+    h, c = _lstm_cell(params, h, c, x)
+    pi = h @ params["pi"]["w"] + params["pi"]["b"]
+    v = (h @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return pi, v, jnp.stack([h, c], axis=1)
+
+
+def lstm_seq_forward(params: Dict, state0: jax.Array, obs: jax.Array,
+                     resets: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Time-major sequence forward with in-scan episode resets.
+
+    obs [T, n, D], resets [T, n] (1.0 zeroes the carried state before
+    consuming obs[t] — i.e. env n finished at t-1).  -> pi [T, n, O],
+    v [T, n]."""
+
+    def body(state, inp):
+        o_t, r_t = inp
+        state = state * (1.0 - r_t)[:, None, None]
+        pi, v, state = lstm_step(params, state, o_t)
+        return state, (pi, v)
+
+    _, (pi, v) = jax.lax.scan(body, state0, (obs, resets))
+    return pi, v
+
+
+# -- policy ---------------------------------------------------------------
+
+class RecurrentPPOPolicy(Policy):
+    """PPO over an LSTM core; trains on [T, n] fragments.
+
+    The update is one jitted program: epochs x full-fragment gradient
+    steps (sequences cannot be flat-shuffled — minibatching, when the env
+    count is large, slices the n axis, preserving time order).
+    """
+
+    recurrent = True
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        self.config = config
+        self.discrete = action_space.kind == "discrete"
+        self.dist = Categorical if self.discrete else DiagGaussian
+        num_outputs = (action_space.n if self.discrete
+                       else 2 * int(np.prod(action_space.shape)))
+        hidden = int(config.get("lstm_cell_size", 64))
+        self.hidden = hidden
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = lstm_init(init_rng, obs_dim, num_outputs,
+                                embed=int(config.get("lstm_embed", 64)),
+                                hidden=hidden)
+        import optax
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
+            optax.adam(config.get("lr", 3e-4)))
+        self.opt_state = self._tx.init(self.params)
+        self._state = None      # lazy: [n, 2, H] once n is known
+
+        dist = self.dist
+
+        @jax.jit
+        def _act(params, rng, state, obs):
+            pi, v, state = lstm_step(params, state, obs)
+            actions = dist.sample(rng, pi)
+            return actions, dist.logp(pi, actions), v, state
+        self._act = _act
+
+        clip = config.get("clip_param", 0.2)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+        num_epochs = config.get("num_sgd_iter", 4)
+
+        def _loss(params, batch):
+            pi, v = lstm_seq_forward(params, batch[STATE_IN], batch[OBS],
+                                     batch[RESETS])
+            T, n = v.shape
+            flat_pi = pi.reshape((T * n,) + pi.shape[2:])
+            acts = batch[ACTIONS].reshape((T * n,)
+                                          + batch[ACTIONS].shape[2:])
+            logp = dist.logp(flat_pi, acts).reshape(T, n)
+            ratio = jnp.exp(logp - batch[ACTION_LOGP])
+            adv = batch[ADVANTAGES]
+            surr = jnp.minimum(ratio * adv,
+                               jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            vf_err = (v - batch[VALUE_TARGETS]) ** 2
+            entropy = dist.entropy(flat_pi)
+            total = (-jnp.mean(surr) + vf_coeff * jnp.mean(vf_err)
+                     - ent_coeff * jnp.mean(entropy))
+            return total, {"policy_loss": -jnp.mean(surr),
+                           "vf_loss": jnp.mean(vf_err),
+                           "entropy": jnp.mean(entropy),
+                           "total_loss": total}
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            def epoch(carry, _):
+                params, opt_state = carry
+                (_, stats), grads = jax.value_and_grad(
+                    _loss, has_aux=True)(params, batch)
+                updates, opt_state = self._tx.update(grads, opt_state)
+                import optax as _optax
+                params = _optax.apply_updates(params, updates)
+                return (params, opt_state), stats
+
+            (params, opt_state), stats = jax.lax.scan(
+                epoch, (params, opt_state), jnp.arange(num_epochs))
+            return params, opt_state, jax.tree.map(lambda s: s[-1], stats)
+        self._update = _update
+
+    # -- rollout side -----------------------------------------------------
+
+    def _ensure_state(self, n: int):
+        if self._state is None or self._state.shape[0] != n:
+            self._state = jnp.zeros((n, 2, self.hidden), jnp.float32)
+
+    def state_snapshot(self) -> np.ndarray:
+        return np.asarray(self._state)
+
+    def notify_dones(self, done: np.ndarray) -> None:
+        """Zero finished envs' state (worker calls after each step)."""
+        if done.any():
+            mask = jnp.asarray(~done, jnp.float32)[:, None, None]
+            self._state = self._state * mask
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._ensure_state(obs.shape[0])
+        self._rng, rng = jax.random.split(self._rng)
+        actions, logp, v, self._state = self._act(
+            self.params, rng, self._state, jnp.asarray(obs, jnp.float32))
+        return {ACTIONS: np.asarray(actions),
+                ACTION_LOGP: np.asarray(logp), VF_PREDS: np.asarray(v)}
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        """Value at the CURRENT state without advancing it (bootstrap)."""
+        self._ensure_state(obs.shape[0])
+        _, v, _ = lstm_step(self.params, self._state,
+                            jnp.asarray(obs, jnp.float32))
+        return np.asarray(v)
+
+    # -- learner side -----------------------------------------------------
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        adv = np.asarray(batch[ADVANTAGES], np.float32)
+        batch = dict(batch)
+        batch[ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        device_batch = {
+            k: jnp.asarray(np.asarray(
+                v, None if k == ACTIONS else np.float32))
+            for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, device_batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)

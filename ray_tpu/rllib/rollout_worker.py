@@ -36,6 +36,12 @@ def _resolve_policy_class(name: str):
     if name == "sac":
         from ray_tpu.rllib.sac import SACPolicy
         return SACPolicy
+    if name == "recurrent_ppo":
+        from ray_tpu.rllib.recurrent import RecurrentPPOPolicy
+        return RecurrentPPOPolicy
+    if name == "bc":
+        from ray_tpu.rllib.offline import BCPolicy
+        return BCPolicy
     raise ValueError(f"unknown policy {name!r}")
 
 
@@ -78,7 +84,58 @@ class RolloutWorker:
             return self._sample_transitions()
         if getattr(self.policy, "sequence_style", False):
             return self._sample_sequences()
+        if getattr(self.policy, "recurrent", False):
+            return self._sample_recurrent()
         return self._sample_onpolicy()
+
+    def _sample_recurrent(self) -> SampleBatch:
+        """Time-major [T, n] fragments for LSTM policies: snapshots the
+        fragment-start hidden state and records per-step reset masks so
+        the learner replays episode boundaries inside its scan
+        (reference: sequence handling in rllib sample collectors)."""
+        from ray_tpu.rllib.recurrent import RESETS, STATE_IN
+        T = self.config.get("rollout_fragment_length", 128)
+        n = self.env.num_envs
+        gamma = self.config.get("gamma", 0.99)
+        lam = self.config.get("lambda", 0.95)
+        self.policy._ensure_state(n)
+        state_in = self.policy.state_snapshot()
+
+        obs_buf = np.empty((T, n) + self._obs.shape[1:], np.float32)
+        act_buf: Optional[np.ndarray] = None
+        logp_buf = np.empty((T, n), np.float32)
+        vf_buf = np.empty((T, n), np.float32)
+        rew_buf = np.empty((T, n), np.float32)
+        done_buf = np.empty((T, n), bool)
+        resets = np.zeros((T, n), np.float32)
+        prev_done = np.zeros((n,), bool)
+
+        for t in range(T):
+            resets[t] = prev_done     # env finished at t-1 -> zero state
+            out = self.policy.compute_actions(self._obs)
+            actions = out[ACTIONS]
+            if act_buf is None:
+                act_buf = np.empty((T,) + actions.shape, actions.dtype)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = out[ACTION_LOGP]
+            vf_buf[t] = out[VF_PREDS]
+            next_obs, reward, done, info = self.env.vector_step(actions)
+            rew_buf[t] = reward
+            done_buf[t] = done
+            self.policy.notify_dones(done)
+            prev_done = done
+            self._record_step_metrics(reward, done)
+            self._obs = next_obs
+
+        last_values = self.policy.compute_values(self._obs)
+        adv, targets = compute_gae(rew_buf, vf_buf, done_buf, last_values,
+                                   gamma, lam)
+        return SampleBatch({
+            OBS: obs_buf, ACTIONS: act_buf, ACTION_LOGP: logp_buf,
+            VF_PREDS: vf_buf, REWARDS: rew_buf, DONES: done_buf,
+            ADVANTAGES: adv, VALUE_TARGETS: targets,
+            STATE_IN: state_in, RESETS: resets})
 
     def _sample_sequences(self) -> SampleBatch:
         """Batch-major [n, T, ...] trajectory fragments with behavior logp

@@ -27,11 +27,28 @@ class WorkerSet:
         # Local worker exists even with 0 remotes (it holds the reference
         # policy the learner updates).
         self.local_worker = RolloutWorker(config, worker_index=0)
+        if getattr(self.local_worker.policy, "recurrent", False) and \
+                config.get("num_rollout_workers", 0) > 0:
+            # Recurrent fragments are [T, n] with per-fragment state;
+            # concat_samples would join them along TIME while state_in
+            # joins along envs — silently corrupting sequences.  Fail at
+            # config time instead of deep inside jit.
+            raise ValueError(
+                "recurrent policies sample with the local worker only; "
+                "set num_rollout_workers=0 (cross-worker fragment concat "
+                "is not wired)")
         self.remote_workers: List[Any] = []
         for i in range(config.get("num_rollout_workers", 0)):
             self.remote_workers.append(self._make_remote(i + 1))
         self._worker_indices = list(
             range(1, len(self.remote_workers) + 1))
+        # Experience output (reference: config.offline_data(output=...)
+        # attaching an OutputWriter to sampling): every sampled batch is
+        # also persisted as a dataset shard for offline training.
+        self._output_writer = None
+        if config.get("output"):
+            from ray_tpu.rllib.offline import DatasetWriter
+            self._output_writer = DatasetWriter(config["output"])
 
     def _make_remote(self, index: int):
         return self._remote_cls.remote(self.config, index)
@@ -49,10 +66,14 @@ class WorkerSet:
         """One round of parallel sampling across all workers (reference
         rollout_ops.synchronous_parallel_sample)."""
         if not self.remote_workers:
-            return self.local_worker.sample()
-        refs = [w.sample.remote() for w in self.remote_workers]
-        batches = ray_tpu.get(refs, timeout=300.0)
-        return SampleBatch.concat_samples(batches)
+            batch = self.local_worker.sample()
+        else:
+            refs = [w.sample.remote() for w in self.remote_workers]
+            batches = ray_tpu.get(refs, timeout=300.0)
+            batch = SampleBatch.concat_samples(batches)
+        if self._output_writer is not None:
+            self._output_writer.write(batch)
+        return batch
 
     def collect_metrics(self) -> Dict[str, Any]:
         rewards: List[float] = []
