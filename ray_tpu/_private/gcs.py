@@ -161,6 +161,10 @@ class GcsServer:
         from collections import deque
         self.task_events: "deque" = deque(maxlen=_rt_config().task_event_retention)
         self.metrics: Dict[tuple, dict] = {}
+        # node_id hex -> latest per-node agent report (workers, load, mem,
+        # object store); feeds /api/node_stats and pid->node routing for
+        # the profiler.  Ephemeral by design (like resource views).
+        self.node_stats: Dict[str, dict] = {}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
         self._health_task: Optional[asyncio.Task] = None
@@ -336,6 +340,42 @@ class GcsServer:
             except Exception:
                 pass
 
+    # ------------------------------------------------- node stats/profile
+
+    async def _h_report_node_stats(self, conn, msg):
+        self.node_stats[msg["node_id"]] = msg["stats"]
+        return None
+
+    async def _h_get_node_stats(self, conn, msg):
+        return self.node_stats
+
+    async def _h_profile_worker(self, conn, msg):
+        """Route a stack-profile request to the raylet hosting ``pid``
+        (reference: dashboard head -> per-node agent -> py-spy)."""
+        pid = int(msg["pid"])
+        # Clamp here too (the worker clamps to 30s): the RPC timeouts
+        # derive from this value and must not honor a user-supplied
+        # 100000s through the HTTP endpoint.
+        msg = {**msg, "duration": min(float(msg.get("duration", 5.0)),
+                                      30.0)}
+        target = msg.get("node_id")
+        if target is None:
+            for nid, stats in self.node_stats.items():
+                if any(w["pid"] == pid for w in stats.get("workers", [])):
+                    target = nid
+                    break
+        if target is None:
+            return {"ok": False,
+                    "error": f"no node reports a worker with pid {pid}"}
+        for node in self.nodes.values():
+            if node.node_id.hex() == target and node.alive and node.conn:
+                return await node.conn.request(
+                    {"type": "profile_worker", "pid": pid,
+                     "duration": msg.get("duration", 5.0),
+                     "interval": msg.get("interval", 0.01)},
+                    timeout=float(msg.get("duration", 5.0)) + 40.0)
+        return {"ok": False, "error": f"node {target} not alive"}
+
     # ------------------------------------------------------------------ kv
 
     async def _h_kv_put(self, conn, msg):
@@ -443,6 +483,9 @@ class GcsServer:
         if not node.alive:
             return
         node.alive = False
+        # Drop its stats report: dead-node workers must neither linger in
+        # the dashboard nor shadow reused pids in profile routing.
+        self.node_stats.pop(node.node_id.hex(), None)
         await self._publish("nodes", {"event": "dead", "node": node.public()})
         # Restart or kill actors that lived on this node.
         for actor in list(self.actors.values()):

@@ -181,6 +181,8 @@ class Raylet:
             self._memory_monitor_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._log_monitor_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._node_stats_loop()))
         return port
 
     async def _publish_logs(self, batch: dict) -> None:
@@ -217,6 +219,95 @@ class Raylet:
         self.plasma.close()
         import shutil
         shutil.rmtree(self.spill_dir, ignore_errors=True)
+
+    # -------------------------------------------------- per-node stats
+
+    async def _node_stats_loop(self):
+        """Per-node agent (reference ``dashboard/agent.py:54`` +
+        ``modules/reporter/reporter_agent.py``): periodically reads
+        per-worker cpu/rss straight from /proc plus node load/memory and
+        object-store occupancy, and reports to the GCS for the dashboard's
+        node view."""
+        interval = float(os.environ.get("RT_NODE_STATS_INTERVAL_S", "2"))
+        prev: Dict[int, Tuple[float, float]] = {}  # pid -> (ticks, when)
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            try:
+                stats = self._collect_node_stats(prev)
+                await self.gcs_conn.notify({
+                    "type": "report_node_stats",
+                    "node_id": self.node_id.hex(),
+                    "stats": stats,
+                })
+            except Exception:
+                logger.debug("node stats report failed", exc_info=True)
+
+    def _collect_node_stats(self, prev: Dict) -> dict:
+        hz = os.sysconf("SC_CLK_TCK")
+        page = os.sysconf("SC_PAGE_SIZE")
+        now = time.monotonic()
+        workers = []
+        for w in self.workers.values():
+            pid = w.proc.pid
+            if w.proc.poll() is not None:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    # utime, stime are fields 14,15; field 2 (comm) may
+                    # contain spaces — split after the closing paren.
+                    parts = f.read().rsplit(")", 1)[1].split()
+                ticks = int(parts[11]) + int(parts[12])
+                with open(f"/proc/{pid}/statm") as f:
+                    rss = int(f.read().split()[1]) * page
+            except (OSError, IndexError, ValueError):
+                continue
+            cpu_pct = 0.0
+            if pid in prev:
+                t0, w0 = prev[pid]
+                dt = now - w0
+                if dt > 0:
+                    cpu_pct = 100.0 * (ticks - t0) / hz / dt
+            prev[pid] = (ticks, now)
+            workers.append({
+                "pid": pid, "worker_id": w.worker_id.hex(),
+                "actor_id": w.actor_id, "busy": w.busy,
+                "rss_bytes": rss, "cpu_percent": round(cpu_pct, 1),
+            })
+        live = {w["pid"] for w in workers}
+        for pid in list(prev):
+            if pid not in live:
+                del prev[pid]
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        mem = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    if k in ("MemTotal", "MemAvailable"):
+                        mem[k] = int(v.strip().split()[0]) * 1024
+        except OSError:
+            pass
+        store = {}
+        try:
+            st = self.plasma.stats()
+            store = {"capacity": st.get("capacity"),
+                     "bytes_used": st.get("bytes_used"),
+                     "num_objects": st.get("num_objects"),
+                     "num_evictions": st.get("num_evictions")}
+        except Exception:
+            pass
+        return {
+            "timestamp": time.time(),
+            "load_avg": [load1, load5, load15],
+            "mem_total": mem.get("MemTotal"),
+            "mem_available": mem.get("MemAvailable"),
+            "object_store": store,
+            "num_workers": len(workers),
+            "workers": workers,
+        }
 
     async def _stuck_lease_watchdog(self):
         """Log scheduler state while leases sit queued — a queued lease
@@ -351,9 +442,25 @@ class Raylet:
             except OSError:
                 pass
             return {"ok": True}
+        if mtype == "profile_worker":
+            return await self._profile_worker(msg)
         if mtype == "pub":
             return None
         raise ValueError(f"raylet: unknown gcs push {mtype}")
+
+    async def _profile_worker(self, msg: dict) -> dict:
+        """Forward a stack-profile request to the worker owning ``pid``
+        (reference: dashboard agent -> ReporterAgent.GetTraceback)."""
+        pid = int(msg["pid"])
+        for w in self.workers.values():
+            if w.proc.pid == pid and w.conn is not None:
+                return await w.conn.request(
+                    {"type": "profile",
+                     "duration": msg.get("duration", 5.0),
+                     "interval": msg.get("interval", 0.01)},
+                    timeout=float(msg.get("duration", 5.0)) + 30.0)
+        return {"ok": False, "error": f"no live worker with pid {pid} on "
+                                      f"node {self.node_id.hex()[:12]}"}
 
     # ------------------------------------------------------------ workers
 

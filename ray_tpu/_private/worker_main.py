@@ -98,12 +98,58 @@ class TaskExecutor:
             return await self._actor_call(conn, msg)
         if mtype == "ping":
             return {"ok": True}
+        if mtype == "profile":
+            return await self._profile(msg)
         if mtype == "cancel_task":
             return self._cancel_task(msg)
         if mtype == "exit":
             asyncio.get_running_loop().call_later(0.1, sys.exit, 0)
             return {"ok": True}
         raise ValueError(f"executor: unknown message {mtype}")
+
+    async def _profile(self, msg: dict) -> dict:
+        """In-process stack sampler over the execution thread.
+
+        Reference analog: ``dashboard/modules/reporter/profile_manager.py``
+        attaches py-spy to a live worker; zero-egress equivalent: a daemon
+        thread samples ``sys._current_frames()`` of the exec thread every
+        ``interval`` for ``duration`` seconds and aggregates identical
+        stacks.  Sampling runs off the IO loop (the loop keeps serving
+        heartbeats/calls while a busy sync body is profiled).
+        """
+        import collections
+
+        duration = float(min(msg.get("duration", 5.0), 30.0))
+        interval = float(max(msg.get("interval", 0.01), 0.001))
+        idents = [t.ident for t in self.core.exec_pool._threads
+                  if t.ident is not None]
+
+        def sample() -> dict:
+            counts: collections.Counter = collections.Counter()
+            samples = 0
+            end = time.monotonic() + duration
+            while time.monotonic() < end:
+                frames = sys._current_frames()
+                samples += 1
+                for ident in idents:
+                    f = frames.get(ident)
+                    stack = []
+                    while f is not None and len(stack) < 40:
+                        code = f.f_code
+                        stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
+                                     f":{f.f_lineno}:{code.co_name}")
+                        f = f.f_back
+                    if stack:
+                        counts[";".join(reversed(stack))] += 1
+                time.sleep(interval)
+            top = counts.most_common(25)
+            return {"ok": True, "pid": os.getpid(), "samples": samples,
+                    "duration": duration,
+                    "stacks": [{"stack": s.split(";"), "count": c}
+                               for s, c in top]}
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, sample)
 
     # -- normal tasks --
 

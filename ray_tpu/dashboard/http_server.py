@@ -53,13 +53,13 @@ class DashboardHttpServer:
                 await self._respond(writer, 405, b"method not allowed",
                                     "text/plain")
                 return
-            path = parts[1].split("?", 1)[0]
+            path, _, query = parts[1].partition("?")
             # Drain headers (ignored).
             while True:
                 line = await asyncio.wait_for(reader.readline(), 10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            await self._route(writer, path)
+            await self._route(writer, path, query)
         except (asyncio.TimeoutError, ConnectionError, OSError):
             pass
         finally:
@@ -79,7 +79,7 @@ class DashboardHttpServer:
             f"Connection: close\r\n\r\n".encode() + body)
         await writer.drain()
 
-    async def _route(self, writer, path: str):
+    async def _route(self, writer, path: str, query: str = ""):
         g = self.gcs
         if path == "/":
             await self._respond(writer, 200, _INDEX_HTML, "text/html")
@@ -88,8 +88,34 @@ class DashboardHttpServer:
             await self._respond(writer, 200, self._prometheus().encode(),
                                 "text/plain; version=0.0.4")
             return
+        if path == "/api/profile":
+            # /api/profile?pid=<pid>[&duration=<s>] -> live stack summary
+            # of that worker (reference: dashboard worker profiling via
+            # the per-node agent, modules/reporter/profile_manager.py).
+            from urllib.parse import parse_qs
+            q = parse_qs(query)
+            if "pid" not in q:
+                await self._respond(writer, 404,
+                                    b'{"error": "pid= required"}')
+                return
+            try:
+                out = await g._h_profile_worker(None, {
+                    "pid": int(q["pid"][0]),
+                    "duration": float(q.get("duration", ["3"])[0]),
+                })
+                await self._respond(writer, 200,
+                                    json.dumps(out, default=str).encode())
+            except (ValueError, TypeError) as e:
+                await self._respond(writer, 404, json.dumps(
+                    {"error": f"bad parameters: {e}"}).encode())
+            except Exception as e:  # noqa: BLE001 - node died mid-profile
+                await self._respond(writer, 200, json.dumps(
+                    {"ok": False, "error": repr(e)}).encode())
+            return
         data = None
-        if path == "/api/nodes":
+        if path == "/api/node_stats":
+            data = g.node_stats
+        elif path == "/api/nodes":
             data = [n.public() for n in g.nodes.values()]
         elif path == "/api/actors":
             data = [a.public() for a in g.actors.values()]
@@ -191,6 +217,7 @@ _INDEX_HTML = b"""<!doctype html>
 <main>
  <div class=cards id=cards></div>
  <h2>Nodes</h2><table id=nodes></table>
+ <h2>Workers (per node)</h2><table id=workers></table>
  <h2>Actors</h2><table id=actors></table>
  <h2>Jobs</h2><table id=jobs></table>
  <h2>Recent tasks</h2><table id=tasks></table>
@@ -206,9 +233,9 @@ function tbl(el,heads,rows){
 }
 async function tick(){
  try{
-  const [sum,nodes,actors,jobs,tasks]=await Promise.all([
+  const [sum,nodes,actors,jobs,tasks,nstats]=await Promise.all([
     J("/api/cluster_summary"),J("/api/nodes"),J("/api/actors"),
-    J("/api/jobs"),J("/api/tasks")]);
+    J("/api/jobs"),J("/api/tasks"),J("/api/node_stats")]);
   const res=(sum.resources||{}).total||{}; const cards=document.getElementById("cards");
   const card=(v,l)=>`<div class=card><b>${v}</b><span>${l}</span></div>`;
   cards.innerHTML=card((sum.nodes||{}).alive??nodes.filter(n=>n.alive).length,"nodes alive")
@@ -219,6 +246,22 @@ async function tick(){
    nodes.map(n=>[esc((n.node_id||"").slice(0,12)),esc(n.address),
     n.alive?'<span class=ok>alive</span>':'<span class=bad>dead</span>',
     esc(JSON.stringify(n.resources_total||n.resources||{}))]));
+  const wrows=[];
+  for(const [nid,st] of Object.entries(nstats||{})){
+   const store=st.object_store||{};
+   for(const w of (st.workers||[])){
+    wrows.push([esc(nid.slice(0,12)),w.pid,esc((w.actor_id||"").slice(0,12)),
+     w.busy?'<span class=bad>busy</span>':'<span class=ok>idle</span>',
+     w.cpu_percent+"%",(w.rss_bytes/1048576).toFixed(1)+" MB",
+     `<a href="/api/profile?pid=${w.pid}&duration=3" target=_blank>profile</a>`]);
+   }
+   wrows.push([esc(nid.slice(0,12)),"&mdash;","node load "+
+    (st.load_avg||[]).map(x=>x.toFixed(2)).join(" / "),"",
+    "store "+((store.bytes_used??0)/1048576).toFixed(1)+" MB",
+    "mem avail "+((st.mem_available??0)/1073741824).toFixed(2)+" GB",""]);
+  }
+  tbl(document.getElementById("workers"),
+   ["node","pid","actor","state","cpu","rss","" ],wrows.slice(0,60));
   tbl(document.getElementById("actors"),["actor","name","state","node"],
    actors.slice(0,50).map(a=>[esc((a.actor_id||"").slice(0,12)),esc(a.name||""),
     a.state=="ALIVE"?'<span class=ok>ALIVE</span>':'<span class=bad>'+esc(a.state)+'</span>',

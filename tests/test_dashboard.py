@@ -4,6 +4,7 @@ Reference analogs: dashboard REST modules + metrics agent exposition.
 """
 
 import json
+import os
 import time
 import urllib.request
 
@@ -74,3 +75,66 @@ def test_dashboard_jobs_listing(dash_cluster):
     base = dash_cluster.get("dashboard_address")
     _, body = _get(base, "/api/jobs")
     assert isinstance(json.loads(body), list)
+
+
+def test_node_stats_and_worker_table(dash_cluster):
+    """Per-node agent (VERDICT r3 #7): raylets report per-worker cpu/rss
+    and object-store occupancy to the GCS; /api/node_stats exposes it."""
+    base = dash_cluster.get("dashboard_address")
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return os.getpid()
+
+    a = Pinger.remote()
+    worker_pid = ray_tpu.get(a.ping.remote())
+
+    deadline = time.monotonic() + 30
+    while True:
+        _, body = _get(base, "/api/node_stats")
+        stats = json.loads(body)
+        pids = [w["pid"] for st in stats.values()
+                for w in st.get("workers", [])]
+        if worker_pid in pids:
+            break
+        assert time.monotonic() < deadline, \
+            f"worker {worker_pid} never appeared in node stats: {stats}"
+        time.sleep(0.5)
+    st = next(iter(stats.values()))
+    assert st["load_avg"] and st["mem_total"] > 0
+    assert st["object_store"].get("capacity", 0) > 0
+    w = next(w for w in st["workers"] if w["pid"] == worker_pid)
+    assert w["rss_bytes"] > 10 * 1024 * 1024   # a live python process
+    assert "cpu_percent" in w
+
+
+def test_profile_endpoint_captures_busy_worker(dash_cluster):
+    """/api/profile?pid= grabs a stack summary of a live worker; a busy
+    sync actor method must dominate the samples (VERDICT r3 #7)."""
+    base = dash_cluster.get("dashboard_address")
+
+    @ray_tpu.remote
+    class Burner:
+        def pid(self):
+            return os.getpid()
+
+        def burn_summing(self, seconds):
+            t0 = time.monotonic()
+            x = 0
+            while time.monotonic() - t0 < seconds:
+                x += sum(range(500))
+            return x
+
+    b = Burner.remote()
+    pid = ray_tpu.get(b.pid.remote())
+    ref = b.burn_summing.remote(8.0)        # busy while we profile
+    time.sleep(0.5)
+    status, body = _get(base, f"/api/profile?pid={pid}&duration=2")
+    assert status == 200
+    prof = json.loads(body)
+    assert prof.get("ok"), prof
+    assert prof["samples"] > 10
+    joined = json.dumps(prof["stacks"])
+    assert "burn_summing" in joined, joined[:500]
+    ray_tpu.get(ref)
