@@ -70,7 +70,7 @@ class DashboardHttpServer:
 
     async def _respond(self, writer, status: int, body: bytes,
                        ctype: str = "application/json"):
-        reason = {200: "OK", 404: "Not Found",
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   405: "Method Not Allowed"}.get(status, "")
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
@@ -95,7 +95,7 @@ class DashboardHttpServer:
             from urllib.parse import parse_qs
             q = parse_qs(query)
             if "pid" not in q:
-                await self._respond(writer, 404,
+                await self._respond(writer, 400,
                                     b'{"error": "pid= required"}')
                 return
             try:
@@ -106,7 +106,7 @@ class DashboardHttpServer:
                 await self._respond(writer, 200,
                                     json.dumps(out, default=str).encode())
             except (ValueError, TypeError) as e:
-                await self._respond(writer, 404, json.dumps(
+                await self._respond(writer, 400, json.dumps(
                     {"error": f"bad parameters: {e}"}).encode())
             except Exception as e:  # noqa: BLE001 - node died mid-profile
                 await self._respond(writer, 200, json.dumps(

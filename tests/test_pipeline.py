@@ -151,3 +151,91 @@ def test_pipeline_moe_ep_trains():
         lambda a, b: float(jnp.abs(a - b).max()),
         params["layers"]["mlp"], params2["layers"]["mlp"]))
     assert max(d) > 0.0
+
+
+# ------------------------------------------------------------ 1F1B + sp
+
+def test_1f1b_loss_and_grad_parity():
+    """The hand-scheduled 1F1B backward must match autodiff numerics
+    (VERDICT r3 #6): loss vs gpt_loss and grads vs jax.grad, in f32."""
+    from ray_tpu.parallel.pipeline import gpt_loss_1f1b
+    mesh, cfg, params, batch = _setup()
+    M = 4   # microbatch size 16/M must stay divisible by dp=4
+    ref = float(gpt_loss(params, batch, cfg))
+    got = float(jax.jit(lambda p, b: gpt_loss_1f1b(
+        p, b, cfg, mesh, num_microbatches=M))(params, batch))
+    assert abs(got - ref) < 1e-5, (got, ref)
+
+    g_ref = jax.grad(lambda p: gpt_loss(p, batch, cfg))(params)
+    g_f1 = jax.jit(jax.grad(lambda p: gpt_loss_1f1b(
+        p, batch, cfg, mesh, num_microbatches=M)))(params)
+    errs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))
+                           / (jnp.max(jnp.abs(a)) + 1e-8)), g_ref, g_f1)
+    worst = max(jax.tree.leaves(errs))
+    assert worst < 1e-4, errs
+
+
+@pytest.mark.slow
+def test_1f1b_trains_and_memory_win():
+    """1F1B's activation footprint is O(pp), not O(M): with M=32 the
+    compiled temp allocation must be well under GPipe's, and the step
+    must still reduce the loss."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_tpu.parallel.pipeline import (make_1f1b_train_step,
+                                           make_pipeline_train_step)
+    mesh = MeshSpec(dp=2, pp=2).build()
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=4,
+                    num_heads=2, embed_dim=32, dtype=jnp.float32)
+    params = gpt_init(jax.random.PRNGKey(1), cfg)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    M = 32
+    tokens = np.random.RandomState(0).randint(0, 128, (M * 2, 33))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    tx = optax.adamw(3e-3)
+    opt = tx.init(params)
+
+    mems = {}
+    for name, mk in (("gpipe", make_pipeline_train_step),
+                     ("1f1b", make_1f1b_train_step)):
+        step = mk(cfg, tx, mesh, num_microbatches=M, donate=False)
+        mems[name] = jax.jit(step).lower(
+            params, opt, batch).compile().memory_analysis() \
+            .temp_size_in_bytes
+    # Measured: ~22.4MB vs ~4.3MB on this shape; assert a conservative 2x.
+    assert mems["1f1b"] * 2 < mems["gpipe"], mems
+
+    step = make_1f1b_train_step(cfg, tx, mesh, num_microbatches=M)
+    p, o = params, opt
+    losses = []
+    for _ in range(12):
+        p, o, m = step(p, o, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.15, losses
+
+
+def test_ring_attention_through_pipeline_stages():
+    """sp threads through stage bodies (VERDICT r3 #6): ring attention
+    inside a pp x sp x dp pipeline matches the dense non-pipelined loss,
+    and gradients are finite."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = MeshSpec(dp=2, pp=2, sp=2).build()
+    cfg_d = GPTConfig(vocab_size=128, max_seq_len=64, num_layers=4,
+                      num_heads=2, embed_dim=32, dtype=jnp.float32)
+    cfg_r = GPTConfig(vocab_size=128, max_seq_len=64, num_layers=4,
+                      num_heads=2, embed_dim=32, dtype=jnp.float32,
+                      attention="ring")
+    params = gpt_init(jax.random.PRNGKey(1), cfg_d)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    tokens = np.random.RandomState(0).randint(0, 128, (8, 65))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    ref = float(gpt_loss(params, batch, cfg_d))
+    got = float(jax.jit(lambda p, b: gpt_loss_pipelined(
+        p, b, cfg_r, mesh, num_microbatches=4))(params, batch))
+    assert abs(got - ref) < 1e-4, (got, ref)
+    g = jax.jit(jax.grad(lambda p: gpt_loss_pipelined(
+        p, batch, cfg_r, mesh, num_microbatches=4)))(params)
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
