@@ -541,7 +541,10 @@ def gpt_loss_1f1b(params, batch, cfg, mesh, *, num_microbatches: int):
         aux = red(aux, all_axes)
         gl = jax.tree.map(lambda g: red(g, non_pp), gl)
         gh = jax.tree.map(lambda g: red(g, all_axes), gh)
-        gx = red(gx, non_mb)
+        # Accumulated in f32 for accuracy; the custom_vjp bwd must hand
+        # back a cotangent with the PRIMAL's dtype (bf16 activations by
+        # default) or jax rejects the rule.
+        gx = red(gx, non_mb).astype(x_mbs.dtype)
         return ll, aux, gl, gh, gx
 
     core_spmd = jax.shard_map(

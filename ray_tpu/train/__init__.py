@@ -13,6 +13,8 @@ from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
 from ray_tpu.train.batch_predictor import BatchPredictor
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.gbdt_trainer import (GBDTTrainer, SklearnTrainer,
+                                        load_estimator)
 from ray_tpu.train.jax.config import JaxConfig
 from ray_tpu.train.jax.jax_trainer import JaxTrainer
 from ray_tpu.train.predictor import JaxPredictor, Predictor
@@ -24,6 +26,9 @@ __all__ = [
     "TrainingFailedError",
     "BatchPredictor",
     "DataParallelTrainer",
+    "GBDTTrainer",
+    "SklearnTrainer",
+    "load_estimator",
     "JaxConfig",
     "JaxTrainer",
     "JaxPredictor",

@@ -239,3 +239,25 @@ def test_ring_attention_through_pipeline_stages():
     g = jax.jit(jax.grad(lambda p: gpt_loss_pipelined(
         p, batch, cfg_r, mesh, num_microbatches=4)))(params)
     assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
+
+
+def test_1f1b_bf16_default_dtype_grads():
+    """The default GPTConfig uses bf16 activations: the custom_vjp must
+    hand back a bf16 x_mbs cotangent or jax rejects the rule (regression
+    for an f32-only bug — every other pipeline test pins f32)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_tpu.parallel.pipeline import gpt_loss_1f1b
+    mesh = MeshSpec(dp=2, pp=2).build()
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=4,
+                    num_heads=2, embed_dim=32)   # default dtype = bf16
+    assert cfg.dtype == jnp.bfloat16
+    params = gpt_init(jax.random.PRNGKey(1), cfg)
+    params["layers"] = jax.device_put(
+        params["layers"], NamedSharding(mesh, P("pp")))
+    tokens = np.random.RandomState(0).randint(0, 128, (8, 33))
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32)}
+    loss, g = jax.jit(jax.value_and_grad(lambda p: gpt_loss_1f1b(
+        p, batch, cfg, mesh, num_microbatches=4)))(params)
+    assert np.isfinite(float(loss))
+    assert all(bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+               for x in jax.tree.leaves(g))

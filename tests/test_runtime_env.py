@@ -72,9 +72,63 @@ def test_actor_runtime_env(renv_cluster):
 
 
 def test_unsupported_keys_rejected(renv_cluster):
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="pip"):
+    with pytest.raises(ValueError, match="conda"):
         f.remote()
+
+
+def _write_demo_pkg(root, name: str, version: str) -> str:
+    """A minimal installable package exposing __version__."""
+    pkg = root / f"{name}-{version}"
+    (pkg / name).mkdir(parents=True)
+    (pkg / name / "__init__.py").write_text(
+        f"__version__ = {version!r}\n")
+    (pkg / "pyproject.toml").write_text(
+        '[build-system]\n'
+        'requires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        '[project]\n'
+        f'name = "{name}"\n'
+        f'version = "{version}"\n'
+        '[tool.setuptools]\n'
+        f'packages = ["{name}"]\n')
+    return str(pkg)
+
+
+def test_pip_env_installs_package_base_env_lacks(renv_cluster, tmp_path):
+    """VERDICT r3 #9: a task runs with a package version the base env
+    doesn't have, via a content-addressed per-env site dir."""
+    pkg = _write_demo_pkg(tmp_path, "rt_pip_demo", "2.5.0")
+
+    @ray_tpu.remote(runtime_env={"pip": [pkg]})
+    def probe():
+        import rt_pip_demo
+        return rt_pip_demo.__version__
+
+    with pytest.raises(ImportError):
+        import rt_pip_demo  # noqa: F401 - must NOT exist in the base env
+    assert ray_tpu.get(probe.remote(), timeout=180) == "2.5.0"
+
+
+def test_concurrent_pip_envs_do_not_collide(renv_cluster, tmp_path):
+    """Two envs with different versions of the same package run
+    concurrently and each sees its own version."""
+    p1 = _write_demo_pkg(tmp_path, "rt_pip_demo2", "1.0.0")
+    p2 = _write_demo_pkg(tmp_path, "rt_pip_demo2", "2.0.0")
+
+    @ray_tpu.remote(runtime_env={"pip": [p1]})
+    def v1():
+        import rt_pip_demo2
+        return rt_pip_demo2.__version__
+
+    @ray_tpu.remote(runtime_env={"pip": [p2]})
+    def v2():
+        import rt_pip_demo2
+        return rt_pip_demo2.__version__
+
+    refs = [v1.remote(), v2.remote(), v1.remote(), v2.remote()]
+    assert ray_tpu.get(refs, timeout=240) == \
+        ["1.0.0", "2.0.0", "1.0.0", "2.0.0"]
