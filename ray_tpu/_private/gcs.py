@@ -183,6 +183,7 @@ class GcsServer:
         return port
 
     async def close(self):
+        self._closing = True
         if self._health_task:
             self._health_task.cancel()
         if self._snapshot_task:
@@ -328,6 +329,8 @@ class GcsServer:
         for subs in self.subscribers.values():
             if conn in subs:
                 subs.remove(conn)
+        if getattr(self, "_closing", False):
+            return   # clean shutdown closes every conn; nothing "died"
         for node in self.nodes.values():
             if node.conn is conn and node.alive:
                 logger.warning("node %s connection lost", node.node_id)

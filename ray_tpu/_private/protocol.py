@@ -361,7 +361,18 @@ class RpcServer:
         conn = RpcConnection(reader, writer, None, name="server-peer")
         conn.handler = self._factory(conn)
         self.connections.append(conn)
-        conn.on_close = lambda c: self.connections.remove(c) if c in self.connections else None
+        # The factory may have installed its own on_close (GCS node-loss
+        # detection, client-session disconnect accounting) — chain it,
+        # don't clobber it.
+        factory_close = conn.on_close
+
+        def _on_close(c):
+            if c in self.connections:
+                self.connections.remove(c)
+            if factory_close is not None:
+                factory_close(c)
+
+        conn.on_close = _on_close
         conn.start()
 
     async def close(self):

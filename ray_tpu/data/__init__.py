@@ -24,8 +24,10 @@ from ray_tpu.data.read_api import (
     read_text,
 )
 
+from ray_tpu.data.push_shuffle import RandomAccessDataset
+
 __all__ = [
-    "ActorPoolStrategy", "Dataset", "DatasetPipeline",
+    "ActorPoolStrategy", "Dataset", "DatasetPipeline", "RandomAccessDataset",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "range_tensor",
     "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",

@@ -377,11 +377,24 @@ class Dataset:
             chunks[i % n].append(ref)
         return [Dataset(c) for c in chunks]
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """2-stage all-to-all shuffle (reference _internal/shuffle.py:
-        partition each block into n shards, merge shard i of every block)."""
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       push_based: Optional[bool] = None) -> "Dataset":
+        """All-to-all shuffle.
+
+        Small datasets use the simple 2-stage map/merge (reference
+        _internal/shuffle.py); at >= 8 blocks (or push_based=True) the
+        push-based plan takes over: map rounds push shards into
+        incremental merger actors, bounding merge fan-in and peak
+        intermediate memory (reference _internal/push_based_shuffle.py).
+        """
         n = max(1, len(self._blocks))
         base_seed = seed if seed is not None else _random.randrange(2**31)
+        if push_based is None:
+            push_based = n >= 8
+        if push_based and n > 1:
+            from ray_tpu.data.push_shuffle import push_based_shuffle
+            return Dataset(push_based_shuffle(list(self._blocks),
+                                              seed=base_seed))
         part_task = ray_tpu.remote(_shuffle_partition)
         merge_task = ray_tpu.remote(_shuffle_merge)
         parts = [

@@ -9,6 +9,7 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy
 from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                Space, VectorEnv, make_vector_env,
                                register_env)
+from ray_tpu.rllib.catalog import AttentionPPOPolicy, ModelCatalog
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
 from ray_tpu.rllib.offline import (BC, BCConfig, BCPolicy, CQL, CQLConfig,
                                    DatasetReader, DatasetWriter,
@@ -28,7 +29,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "BCPolicy",
+    "Algorithm", "AlgorithmConfig", "AttentionPPOPolicy", "BC", "BCConfig",
+    "BCPolicy", "ModelCatalog",
     "CartPoleVectorEnv", "CQL", "CQLConfig", "DatasetReader",
     "DatasetWriter", "DQN", "DQNConfig", "DQNPolicy", "Env", "Impala",
     "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",

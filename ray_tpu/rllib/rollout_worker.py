@@ -39,6 +39,9 @@ def _resolve_policy_class(name: str):
     if name == "recurrent_ppo":
         from ray_tpu.rllib.recurrent import RecurrentPPOPolicy
         return RecurrentPPOPolicy
+    if name == "attention_ppo":
+        from ray_tpu.rllib.catalog import AttentionPPOPolicy
+        return AttentionPPOPolicy
     if name == "bc":
         from ray_tpu.rllib.offline import BCPolicy
         return BCPolicy
@@ -57,7 +60,10 @@ class RolloutWorker:
             config["env"], config.get("num_envs_per_worker", 1), seed=seed,
             **config.get("env_config", {}))
         obs_dim = int(np.prod(self.env.observation_space.shape))
-        self.policy = _resolve_policy_class(config.get("policy", "ppo"))(
+        # model={"use_lstm"/"use_attention": True} routes through the
+        # catalog, like the reference's ModelCatalog wrapper selection.
+        from ray_tpu.rllib.catalog import ModelCatalog
+        self.policy = _resolve_policy_class(ModelCatalog.policy_for(config))(
             obs_dim, self.env.action_space, config, seed=seed)
         self._obs = self.env.vector_reset(seed=seed)
         n = self.env.num_envs

@@ -169,6 +169,16 @@ class GBDTTrainer(DataParallelTrainer):
             Xv = yv = None
             if vds is not None:
                 Xv, yv = _dataset_to_xy(vds, config["label_column"])
+            if est is not None and done >= total:
+                # Checkpoint already covers the requested rounds: still
+                # report once, or fit() returns an empty Result and the
+                # caller's load_estimator(result.checkpoint) breaks.
+                metrics = {"boost_round": done,
+                           "train_score": float(est.score(X, y))}
+                if Xv is not None:
+                    metrics["valid_score"] = float(est.score(Xv, yv))
+                session.report(metrics,
+                               checkpoint=_estimator_checkpoint(est))
             while done < total:
                 done = min(done + chunk, total)
                 if est is None:

@@ -205,3 +205,38 @@ def test_zip_misaligned_blocks(ray_start):
     c = rd.from_items([{"y": 0}] * 3, parallelism=1)
     with pytest.raises(Exception):
         a.zip(c).take_all()
+
+
+# ----------------------------------------- push shuffle + random access
+
+
+def test_push_based_shuffle_preserves_rows(ray_start):
+    ds = rd.from_items(list(range(200))).repartition(10)
+    out = ds.random_shuffle(seed=7, push_based=True)
+    rows = sorted(out.take_all())
+    assert rows == list(range(200))
+    # and it genuinely permuted
+    assert out.take_all() != list(range(200))
+    # block count preserved (one output partition per merger)
+    assert len(out._blocks) == 10
+
+
+def test_push_based_shuffle_auto_threshold(ray_start):
+    small = rd.from_items(list(range(20))).repartition(2)
+    assert sorted(small.random_shuffle(seed=1).take_all()) == list(range(20))
+    big = rd.from_items(list(range(64))).repartition(8)  # auto push path
+    assert sorted(big.random_shuffle(seed=1).take_all()) == list(range(64))
+
+
+def test_random_access_dataset_point_lookups(ray_start):
+    from ray_tpu.data import RandomAccessDataset
+    rows = [{"id": i, "val": i * i} for i in range(100)]
+    ds = rd.from_items(rows).repartition(5)
+    rad = RandomAccessDataset(ds, "id", num_workers=2)
+    assert ray_tpu.get(rad.get_async(17)) == {"id": 17, "val": 289}
+    assert ray_tpu.get(rad.get_async(0))["val"] == 0
+    assert ray_tpu.get(rad.get_async(1000)) is None
+    got = rad.multiget([3, 99, 41, -5])
+    assert [g["val"] if g else None for g in got] == [9, 9801, 1681, None]
+    st = rad.stats()
+    assert st["num_partitions"] == 2 and sum(st["rows_per_partition"]) == 100

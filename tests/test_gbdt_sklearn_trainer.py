@@ -84,6 +84,17 @@ def test_gbdt_trainer_resumes_mid_boost(cluster):
     rounds = [m["boost_round"] for m in r2.metrics_history]
     assert rounds == [12, 16], rounds
 
+    # Degenerate resume (target already reached): still reports once
+    # with the loaded estimator instead of returning an empty Result.
+    again = GBDTTrainer(
+        label_column="label", params={"learning_rate": 0.2},
+        num_boost_round=16, rounds_per_report=4,
+        datasets={"train": train_ds, "valid": valid_ds},
+        resume_from_checkpoint=r2.checkpoint)
+    r3 = again.fit()
+    assert r3.metrics["boost_round"] == 16
+    assert load_estimator(r3.checkpoint).n_iter_ == 16
+
 
 def test_gbdt_regression_objective(cluster):
     rng = np.random.default_rng(1)
