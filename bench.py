@@ -191,6 +191,7 @@ def child_main() -> None:
     devices = jax.devices()
     on_tpu = any(d.platform in ("tpu", "axon") for d in devices)
     batch, seq = (32, 1024) if on_tpu else (2, 128)
+    batch = int(os.environ.get("RT_BENCH_BATCH", 0)) or batch
     cfg = GPTConfig.gpt2_small() if on_tpu else GPTConfig.tiny()
     # Flash attention (round-3 Pallas kernels with the real FA2 backward)
     # beats XLA dense at bench scale: 20.9 vs 28.8 ms fwd+bwd per attention

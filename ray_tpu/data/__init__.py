@@ -8,7 +8,18 @@ ActorPoolStrategy), read_api.py datasources, DatasetPipeline
 ready for device put, and ``split`` aligns shards with a train worker gang.
 """
 
-from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
+    AggregateFn,
+    Count,
+    Dataset,
+    GroupedData,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (
     from_arrow,
@@ -27,7 +38,8 @@ from ray_tpu.data.read_api import (
 from ray_tpu.data.push_shuffle import RandomAccessDataset
 
 __all__ = [
-    "ActorPoolStrategy", "Dataset", "DatasetPipeline", "RandomAccessDataset",
+    "ActorPoolStrategy", "AggregateFn", "Count", "Dataset", "DatasetPipeline",
+    "GroupedData", "Max", "Mean", "Min", "RandomAccessDataset", "Std", "Sum",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "range_tensor",
     "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",

@@ -169,6 +169,228 @@ def _merge_sorted(key, descending, *blocks):
     return merged
 
 
+def _groupby_partition(block, key, n):
+    """Stage 1 of groupby: hash-partition a block's rows by group key into
+    ``n`` shards (same 2-stage shape as random_shuffle)."""
+    shards = [[] for _ in builtins.range(n)]
+    for row in BlockAccessor(block).rows():
+        k = _group_key(row, key)
+        shards[_stable_hash(k) % n].append(row)
+    return tuple(shards) if n > 1 else shards[0]
+
+
+def _stable_hash(k) -> int:
+    """Deterministic cross-process hash: partition tasks run in different
+    worker processes, where Python's ``hash()`` of str/bytes is randomized
+    per interpreter (PYTHONHASHSEED) — the same key must land in the same
+    reduce partition regardless of which worker hashed it."""
+    import hashlib
+    return int.from_bytes(
+        hashlib.md5(_canonical_key(k).encode()).digest()[:8], "little")
+
+
+def _canonical_key(k) -> str:
+    """Equality-consistent canonical form: keys that compare == MUST map
+    to the same string (1 == 1.0 == np.int64(1) == True), or the reduce
+    stage — which groups by dict equality — would see one logical group
+    split across partitions.  Unequal keys sharing a form is harmless
+    (they just co-locate)."""
+    if isinstance(k, (bool, int, float, np.integer, np.floating)):
+        try:
+            return repr(float(k))
+        except OverflowError:       # int beyond float range
+            return repr(int(k))
+    if isinstance(k, tuple):
+        return "(" + ",".join(_canonical_key(x) for x in k) + ")"
+    return repr(k)
+
+
+def _groupby_reduce(key, aggs, *shards):
+    """Stage 2: merge co-hashed shards, group, and run each AggregateFn's
+    accumulate/finalize over every group. Emits one dict row per group."""
+    groups: Dict[Any, list] = {}
+    for shard in shards:
+        for row in BlockAccessor(shard).rows():
+            groups.setdefault(_group_key(row, key), []).append(row)
+    out = []
+    for k in sorted(groups, key=repr):
+        rows = groups[k]
+        res = {} if key is None or callable(key) else {key: k}
+        if key is not None and callable(key):
+            res["key"] = k
+        for agg in aggs:
+            acc = agg.init(k)
+            for r in rows:
+                acc = agg.accumulate(acc, r)
+            res[agg.name] = agg.finalize(acc)
+        out.append(res)
+    return out
+
+
+def _groupby_map_groups(key, fn, batch_format, *shards):
+    groups: Dict[Any, list] = {}
+    for shard in shards:
+        for row in BlockAccessor(shard).rows():
+            groups.setdefault(_group_key(row, key), []).append(row)
+    out = []
+    for k in sorted(groups, key=repr):
+        rows = groups[k]
+        if batch_format == "pandas":
+            import pandas as pd
+            res = fn(pd.DataFrame(rows))
+            out.extend(res.to_dict("records") if hasattr(res, "to_dict")
+                       else list(res))
+        else:
+            res = fn(rows)
+            out.extend(res if isinstance(res, list) else list(res))
+    return out
+
+
+def _group_key(row, key):
+    if key is None:
+        return None
+    if callable(key):
+        return key(row)
+    return row[key]
+
+
+class AggregateFn:
+    """User-definable aggregation (reference: ``data/aggregate.py``
+    ``AggregateFn``): init(key) -> acc, accumulate(acc, row) -> acc,
+    merge(a, b) -> acc, finalize(acc) -> value."""
+
+    def __init__(self, init, accumulate, finalize=None, name="agg",
+                 merge=None):
+        self.init = init
+        self.accumulate = accumulate
+        self.finalize = finalize or (lambda a: a)
+        self.merge = merge
+        self.name = name
+
+
+def _on_value(row, on):
+    return row[on] if on is not None else row
+
+
+class Count(AggregateFn):
+    def __init__(self):
+        super().__init__(lambda k: 0, lambda a, r: a + 1, name="count()")
+
+
+class Sum(AggregateFn):
+    def __init__(self, on=None):
+        super().__init__(lambda k: 0,
+                         lambda a, r: a + _on_value(r, on),
+                         name=f"sum({on})" if on else "sum()")
+
+
+class Min(AggregateFn):
+    def __init__(self, on=None):
+        super().__init__(lambda k: None,
+                         lambda a, r: _on_value(r, on) if a is None
+                         else builtins.min(a, _on_value(r, on)),
+                         name=f"min({on})" if on else "min()")
+
+
+class Max(AggregateFn):
+    def __init__(self, on=None):
+        super().__init__(lambda k: None,
+                         lambda a, r: _on_value(r, on) if a is None
+                         else builtins.max(a, _on_value(r, on)),
+                         name=f"max({on})" if on else "max()")
+
+
+class Mean(AggregateFn):
+    def __init__(self, on=None):
+        super().__init__(lambda k: (0.0, 0),
+                         lambda a, r: (a[0] + _on_value(r, on), a[1] + 1),
+                         lambda a: a[0] / a[1] if a[1] else float("nan"),
+                         name=f"mean({on})" if on else "mean()")
+
+
+class Std(AggregateFn):
+    """Sample std via (n, sum, sumsq) — numerically fine at test scales and
+    trivially mergeable."""
+
+    def __init__(self, on=None, ddof=1):
+        def fin(a):
+            n, s, ss = a
+            if n <= ddof:
+                return 0.0
+            var = (ss - s * s / n) / (n - ddof)
+            return float(builtins.max(var, 0.0) ** 0.5)
+        super().__init__(
+            lambda k: (0, 0.0, 0.0),
+            lambda a, r: (a[0] + 1, a[1] + _on_value(r, on),
+                          a[2] + _on_value(r, on) ** 2),
+            fin, name=f"std({on})" if on else "std()")
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby`` (reference:
+    ``python/ray/data/grouped_dataset.py`` ``GroupedData``). Aggregations
+    run as a distributed hash shuffle: stage 1 hash-partitions every block
+    by group key; stage 2 runs one reduce task per partition, so distinct
+    keys never cross partitions and each group is aggregated exactly once.
+    """
+
+    def __init__(self, ds: "Dataset", key: Union[str, Callable, None]):
+        self._ds = ds
+        self._key = key
+
+    def _partitions(self, n: Optional[int] = None):
+        blocks = self._ds._blocks
+        n = n or builtins.min(builtins.max(len(blocks), 1), 32)
+        part = ray_tpu.remote(_groupby_partition)
+        parts = [part.options(num_returns=n).remote(b, self._key, n)
+                 for b in blocks]
+        if n == 1:
+            parts = [[p] for p in parts]
+        return n, parts
+
+    def aggregate(self, *aggs: AggregateFn) -> "Dataset":
+        if not aggs:
+            raise ValueError("aggregate: at least one AggregateFn required")
+        n, parts = self._partitions()
+        reduce_task = ray_tpu.remote(_groupby_reduce)
+        refs = [reduce_task.remote(self._key, list(aggs),
+                                   *[parts[i][j]
+                                     for i in builtins.range(len(parts))])
+                for j in builtins.range(n)]
+        return Dataset(refs)
+
+    def map_groups(self, fn: Callable, *,
+                   batch_format: str = "default") -> "Dataset":
+        """Apply ``fn`` to each group's rows (list or DataFrame per
+        ``batch_format``); fn returns rows (reference:
+        GroupedData.map_groups)."""
+        n, parts = self._partitions()
+        task = ray_tpu.remote(_groupby_map_groups)
+        refs = [task.remote(self._key, fn, batch_format,
+                            *[parts[i][j]
+                              for i in builtins.range(len(parts))])
+                for j in builtins.range(n)]
+        return Dataset(refs)
+
+    def count(self) -> "Dataset":
+        return self.aggregate(Count())
+
+    def sum(self, on=None) -> "Dataset":
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None) -> "Dataset":
+        return self.aggregate(Min(on))
+
+    def max(self, on=None) -> "Dataset":
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None) -> "Dataset":
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None, ddof=1) -> "Dataset":
+        return self.aggregate(Std(on, ddof))
+
+
 def _fused_stages(stages, block):
     """Run a chain of lazy stages as ONE task (reference: _internal/plan.py
     stage fusion — N map stages cost one task per block, not N)."""
@@ -465,6 +687,12 @@ class Dataset:
                         builtins.zip(self._blocks, other._blocks)])
 
     # -- aggregates -------------------------------------------------------
+    def groupby(self, key: Union[str, Callable, None]) -> "GroupedData":
+        """Group rows by a column name or key function (reference:
+        ``Dataset.groupby`` -> ``grouped_dataset.py`` GroupedData).
+        ``key=None`` forms a single global group."""
+        return GroupedData(self, key)
+
     def _values(self, on: Optional[str]) -> List[float]:
         vals = []
         for r in self.iter_rows():

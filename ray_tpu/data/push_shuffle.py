@@ -104,8 +104,32 @@ def push_based_shuffle(blocks: List[Any], *, seed: int,
     # Barrier over EVERY round's adds: a failed fold must surface as an
     # exception, not as silently missing rows in the output.
     ray_tpu.get(all_adds)
-    return [m.finalize.remote(seed + 104729 + j)
-            for j, m in enumerate(mergers)]
+    merged = [m.finalize.remote(seed + 104729 + j)
+              for j, m in enumerate(mergers)]
+    if n_out == n:
+        return merged
+    # Fewer mergers than input blocks: re-split each merger's output so
+    # the shuffle preserves the dataset's block count (downstream
+    # block-aligned ops — zip, split gangs — rely on it).
+    split_task = ray_tpu.remote(_split_block_even)
+    out: List[Any] = []
+    base, extra = divmod(n, n_out)
+    for j, ref in enumerate(merged):
+        q = base + (1 if j < extra else 0)
+        if q <= 1:
+            out.append(ref)
+        else:
+            out.extend(split_task.options(num_returns=q).remote(ref, q))
+    return out
+
+
+def _split_block_even(block, q: int):
+    """Slice one block into q near-equal row ranges (tuple of blocks)."""
+    from ray_tpu.data.block import BlockAccessor
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    bounds = [rows * i // q for i in range(q + 1)]
+    return tuple(acc.slice(bounds[i], bounds[i + 1]) for i in range(q))
 
 
 # -------------------------------------------------- random-access serving

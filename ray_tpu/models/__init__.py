@@ -15,6 +15,13 @@ from ray_tpu.models.gpt import (  # noqa: F401
     make_train_step,
     make_train_state,
 )
+from ray_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_param_axes,
+)
 from ray_tpu.models.mlp import (  # noqa: F401
     mlp_forward,
     mlp_init,

@@ -35,9 +35,10 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16        # compute dtype (params stay f32)
     remat: bool = True
     # "full" recomputes the whole block in bwd (min memory); "dots" saves
-    # matmul outputs and recomputes only elementwise ops (good middle
-    # ground when activations fit HBM).
-    remat_policy: str = "full"       # "full" | "dots"
+    # matmul outputs and recomputes only elementwise ops; "attn" saves
+    # only attention outputs (never re-runs the flash kernel in bwd);
+    # "attn_dots" saves both (fastest when it fits HBM).
+    remat_policy: str = "full"   # "full" | "dots" | "attn" | "attn_dots"
     attention: str = "dense"   # "dense" | "flash" | "ring" (ring needs sp>1)
     # MoE (0 = dense FFN).  Experts shard over the ep mesh axis; routing is
     # GShard/Switch-style capacity-bounded dispatch (ray_tpu/ops/moe.py).
@@ -157,6 +158,9 @@ def gpt_param_axes(cfg: GPTConfig) -> Dict[str, Any]:
     }
 
 
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+
+
 def _layer_norm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -212,7 +216,7 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
         q = lc(q, ("batch", "heads", "seq", "kv"))
         k = lc(k, ("batch", "heads", "seq", "kv"))
         v = lc(v, ("batch", "heads", "seq", "kv"))
-        o = attn_fn(q, k, v)
+        o = _checkpoint_name(attn_fn(q, k, v), "attn_out")
         o = jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
     else:
         qkv = jnp.einsum("bsd,dcnh->bscnh", h, p["attn"]["wqkv"].astype(dt))
@@ -220,7 +224,7 @@ def _block(cfg: GPTConfig, rules: Optional[LogicalAxisRules],
         q = lc(q, ("batch", "seq", "heads", "kv"))
         k = lc(k, ("batch", "seq", "heads", "kv"))
         v = lc(v, ("batch", "seq", "heads", "kv"))
-        o = attn_fn(q, k, v)
+        o = _checkpoint_name(attn_fn(q, k, v), "attn_out")
         o = jnp.einsum("bsnh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
     x = x + o + p["attn"]["bo"].astype(dt)
     x = lc(x, ("batch", "seq", "embed"))
@@ -283,8 +287,22 @@ def gpt_forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
 
     block = functools.partial(_block, cfg, rules, attn_fn)
     if cfg.remat:
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if cfg.remat_policy == "dots" else None)
+        cp = jax.checkpoint_policies
+        if cfg.remat_policy == "dots":
+            policy = cp.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "attn":
+            # Save the attention outputs (tagged via checkpoint_name in
+            # _block): the backward pass recomputes the cheap projections
+            # and MLP but never re-runs the attention kernel — the single
+            # most expensive recompute under "full"/"dots" when attention
+            # is the Pallas flash kernel.
+            policy = cp.save_only_these_names("attn_out")
+        elif cfg.remat_policy == "attn_dots":
+            policy = cp.save_from_both_policies(
+                cp.dots_with_no_batch_dims_saveable,
+                cp.save_only_these_names("attn_out"))
+        else:
+            policy = None
         block = jax.checkpoint(block, policy=policy)
 
     def scan_body(carry, layer_params):

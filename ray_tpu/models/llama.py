@@ -1,0 +1,244 @@
+"""LLaMA-family decoder-only transformer, TPU-first.
+
+Second flagship model family beside GPT-2 (``models/gpt.py``): RMSNorm
+pre-norm, rotary position embeddings (no learned positions), SwiGLU MLP,
+untied LM head, and grouped-query attention (kv_heads <= heads).  Same
+TPU-first construction as GPT: bf16 compute / f32 params, layers stacked
+on a scanned [L, ...] dim (single XLA while-loop; the dim doubles as the
+pp shard axis), logical-axis annotations on every param so one definition
+runs dp/fsdp/tp/sp via the ``ray_tpu.parallel`` rule tables, per-layer
+``jax.checkpoint`` with the same policy menu as GPT, and the same
+pluggable attention body (dense / Pallas flash).
+
+The reference has no model zoo of its own (its flagship benchmarks wrap
+torchvision/HF models); this family exists so Train/Tune/Serve have a
+modern-architecture model to exercise, matching
+``release/air_tests/air_benchmarks``' role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+
+from ray_tpu.models.gpt import token_loglikes
+from ray_tpu.parallel.sharding import (LogicalAxisRules,
+                                       with_logical_constraint)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 4            # GQA: kv_heads < heads shares K/V
+    embed_dim: int = 768
+    mlp_dim: int = 2048              # SwiGLU hidden (~8/3 * embed, /128 pad)
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    remat_policy: str = "full"   # same menu as GPTConfig
+    attention: str = "dense"         # "dense" | "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @staticmethod
+    def llama_125m() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 256, seq: int = 128) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab, max_seq_len=seq, num_layers=2,
+                           num_heads=4, num_kv_heads=2, embed_dim=64,
+                           mlp_dim=192)
+
+
+def llama_init(rng: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Params with per-layer weights stacked on a leading [L] dim."""
+    if cfg.num_heads % cfg.num_kv_heads:
+        raise ValueError(f"num_heads={cfg.num_heads} must be divisible by "
+                         f"num_kv_heads={cfg.num_kv_heads}")
+    k = jax.random.split(rng, 8)
+    D, H, M, L, V = (cfg.embed_dim, cfg.head_dim, cfg.mlp_dim,
+                     cfg.num_layers, cfg.vocab_size)
+    nh, nkv = cfg.num_heads, cfg.num_kv_heads
+    scale = 0.02
+    rscale = scale / np.sqrt(2 * L)
+    return {
+        "wte": scale * jax.random.normal(k[0], (V, D), jnp.float32),
+        "layers": {
+            "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
+            "attn": {
+                "wq": scale * jax.random.normal(k[1], (L, D, nh, H),
+                                                jnp.float32),
+                "wkv": scale * jax.random.normal(k[2], (L, D, 2, nkv, H),
+                                                 jnp.float32),
+                "wo": rscale * jax.random.normal(k[3], (L, nh, H, D),
+                                                 jnp.float32),
+            },
+            "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
+            "mlp": {
+                # SwiGLU: gate and up projections fused on a leading 2-dim.
+                "wgu": scale * jax.random.normal(k[4], (L, 2, D, M),
+                                                 jnp.float32),
+                "wd": rscale * jax.random.normal(k[5], (L, M, D),
+                                                 jnp.float32),
+            },
+        },
+        "ln_f": {"scale": jnp.ones((D,), jnp.float32)},
+        "lm_head": scale * jax.random.normal(k[6], (D, V), jnp.float32),
+    }
+
+
+def llama_param_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Logical-axis annotations matching ``llama_init`` (same rule table
+    as GPT: heads/mlp -> tp, embed -> fsdp, layers -> pp)."""
+    return {
+        "wte": (None, "embed"),
+        "layers": {
+            "ln1": {"scale": ("layers", "norm")},
+            "attn": {
+                "wq": ("layers", "embed", "heads", "kv"),
+                "wkv": ("layers", "embed", None, "heads", "kv"),
+                "wo": ("layers", "heads", "kv", "embed"),
+            },
+            "ln2": {"scale": ("layers", "norm")},
+            "mlp": {
+                "wgu": ("layers", None, "embed", "mlp"),
+                "wd": ("layers", "mlp", "embed"),
+            },
+        },
+        "ln_f": {"scale": ("norm",)},
+        "lm_head": ("embed", None),
+    }
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                            + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def rope_tables(S: int, H: int, theta: float) -> tuple:
+    """(cos, sin) [S, H/2] f32 tables for rotary embeddings."""
+    inv_freq = 1.0 / theta ** (np.arange(0, H, 2, dtype=np.float32) / H)
+    t = np.arange(S, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)
+    return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+
+
+def apply_rope(x, cos, sin):
+    """Rotate [..., S, H] pairs (x split halves convention, like LLaMA's
+    reshape-free implementations).  cos/sin broadcast over leading dims."""
+    H = x.shape[-1]
+    x1, x2 = x[..., : H // 2], x[..., H // 2:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+def _block(cfg: LlamaConfig, rules: Optional[LogicalAxisRules],
+           attn_fn: Callable, cos, sin, x, p):
+    lc = (lambda a, ax: with_logical_constraint(a, rules, ax)) if rules \
+        else (lambda a, ax: a)
+    dt = cfg.dtype
+    rep = cfg.num_heads // cfg.num_kv_heads
+
+    h = _rms_norm(x, p["ln1"]["scale"], cfg.rms_eps)
+    # Head-major [B, N, S, H] throughout: native layout for the flash
+    # kernels, picked in the projection epilogue for free.
+    q = jnp.einsum("bsd,dnh->bnsh", h, p["attn"]["wq"].astype(dt))
+    kv = jnp.einsum("bsd,dcnh->bcnsh", h, p["attn"]["wkv"].astype(dt))
+    k, v = kv[:, 0], kv[:, 1]
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if rep > 1:   # GQA: share each kv head across `rep` query heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    q = lc(q, ("batch", "heads", "seq", "kv"))
+    k = lc(k, ("batch", "heads", "seq", "kv"))
+    v = lc(v, ("batch", "heads", "seq", "kv"))
+    o = _checkpoint_name(attn_fn(q, k, v), "attn_out")
+    x = x + jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+    x = lc(x, ("batch", "seq", "embed"))
+
+    h = _rms_norm(x, p["ln2"]["scale"], cfg.rms_eps)
+    gu = jnp.einsum("bsd,cdm->cbsm", h, p["mlp"]["wgu"].astype(dt))
+    h = jax.nn.silu(gu[0]) * gu[1]
+    h = lc(h, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum("bsm,md->bsd", h, p["mlp"]["wd"].astype(dt))
+    return lc(x, ("batch", "seq", "embed"))
+
+
+def llama_forward(params: Dict[str, Any], tokens: jax.Array,
+                  cfg: LlamaConfig,
+                  rules: Optional[LogicalAxisRules] = None,
+                  mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (compute dtype; the fused
+    loss upcasts inside its reductions, same contract as gpt_forward)."""
+    dt = cfg.dtype
+    S = tokens.shape[1]
+    if cfg.attention == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        def attn_fn(q, k, v):
+            return flash_attention(q, k, v, True, None, None, None, None,
+                                   "bnsh")
+    else:
+        from ray_tpu.models.gpt import _dense_causal_attention_bnsh
+        attn_fn = _dense_causal_attention_bnsh
+
+    cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+    x = params["wte"].astype(dt)[tokens]
+    if rules is not None:
+        x = with_logical_constraint(x, rules, ("batch", "seq", "embed"))
+
+    block = functools.partial(_block, cfg, rules, attn_fn, cos, sin)
+    if cfg.remat:
+        cp = jax.checkpoint_policies
+        policy = {
+            "dots": cp.dots_with_no_batch_dims_saveable,
+            "attn": cp.save_only_these_names("attn_out"),
+            "attn_dots": cp.save_from_both_policies(
+                cp.dots_with_no_batch_dims_saveable,
+                cp.save_only_these_names("attn_out")),
+        }.get(cfg.remat_policy)
+        block = jax.checkpoint(block, policy=policy)
+
+    x, _ = jax.lax.scan(lambda c, lp: (block(c, lp), None), x,
+                        params["layers"])
+    x = _rms_norm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dt))
+
+
+def llama_loss(params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
+               rules: Optional[LogicalAxisRules] = None,
+               mesh=None) -> jax.Array:
+    """Next-token CE over {"tokens": [B, S+1]} — shares the fused
+    ``token_loglikes`` core with GPT."""
+    toks = batch["tokens"]
+    logits = llama_forward(params, toks[:, :-1], cfg, rules, mesh)
+    return -jnp.mean(token_loglikes(logits, toks[:, 1:]))
+
+
+def make_train_step(cfg: LlamaConfig, tx,
+                    rules: Optional[LogicalAxisRules] = None,
+                    mesh=None, donate: bool = True):
+    """Jitted (params, opt_state, batch) -> (params, opt_state, metrics);
+    delegates to the GPT train-step plumbing with this family's loss."""
+    from ray_tpu.models import gpt as _gpt
+    return _gpt.make_train_step(
+        cfg, tx, rules, mesh, donate=donate,
+        loss_fn=lambda p, b: llama_loss(p, b, cfg, rules, mesh))

@@ -4,8 +4,11 @@ PPO first (reference rllib/algorithms/ppo/), on the Podracer split: env
 rollouts on host-CPU actors, one jitted learner program on the device.
 """
 
+from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CPolicy
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy
+from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.td3 import TD3, TD3Config, TD3Policy
 from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                Space, VectorEnv, make_vector_env,
                                register_env)
@@ -13,7 +16,8 @@ from ray_tpu.rllib.catalog import AttentionPPOPolicy, ModelCatalog
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
 from ray_tpu.rllib.offline import (BC, BCConfig, BCPolicy, CQL, CQLConfig,
                                    DatasetReader, DatasetWriter,
-                                   ImportanceSamplingEstimator)
+                                   ImportanceSamplingEstimator, MARWIL,
+                                   MARWILConfig, MARWILPolicy)
 from ray_tpu.rllib.policy import Policy, PPOPolicy, compute_gae
 from ray_tpu.rllib.ppo import (PPO, PPOConfig, RecurrentPPO,
                                RecurrentPPOConfig)
@@ -29,16 +33,19 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = [
+    "A2C", "A2CConfig", "A2CPolicy",
     "Algorithm", "AlgorithmConfig", "AttentionPPOPolicy", "BC", "BCConfig",
     "BCPolicy", "ModelCatalog",
     "CartPoleVectorEnv", "CQL", "CQLConfig", "DatasetReader",
-    "DatasetWriter", "DQN", "DQNConfig", "DQNPolicy", "Env", "Impala",
+    "DatasetWriter", "DQN", "DQNConfig", "DQNPolicy", "ES", "ESConfig",
+    "Env", "Impala",
     "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",
+    "MARWIL", "MARWILConfig", "MARWILPolicy",
     "PendulumVectorEnv", "Policy", "PPO", "PPOConfig", "PPOPolicy",
     "PrioritizedReplayBuffer", "RecurrentPPO", "RecurrentPPOConfig",
     "RecurrentPPOPolicy", "ReplayBuffer", "RolloutWorker", "SampleBatch",
-    "Space", "VectorEnv", "WorkerSet", "compute_gae", "make_vector_env",
-    "register_env",
+    "Space", "TD3", "TD3Config", "TD3Policy", "VectorEnv", "WorkerSet",
+    "compute_gae", "make_vector_env", "register_env",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -1,0 +1,138 @@
+"""A2C: synchronous advantage actor-critic.
+
+Design analog: reference ``rllib/algorithms/a2c/a2c.py`` (synchronous
+parallel sampling -> ONE gradient step on the whole batch -> broadcast;
+the non-clipped, non-epoch little sibling of PPO).  TPU-first: the update
+is a single jitted program; rollout workers are host-CPU actors sharing
+PPO's GAE postprocessing (policy.py compute_gae).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import (Categorical, DiagGaussian, Policy,
+                                  ac_forward, ac_init)
+from ray_tpu.rllib.sample_batch import (ACTIONS, ACTION_LOGP, ADVANTAGES,
+                                        OBS, SampleBatch, VALUE_TARGETS,
+                                        VF_PREDS)
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(A2C)
+        self._config.update({
+            "policy": "a2c",
+            "lambda": 1.0,                  # plain returns by default
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "grad_clip": 0.5,
+            "lr": 1e-3,
+            "hiddens": (64, 64),
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 32,
+        })
+
+
+class A2CPolicy(Policy):
+    """Vanilla policy-gradient + value loss, one gradient step per train
+    batch (no ratio clipping, no minibatch epochs — that's PPO)."""
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        self.config = config
+        self.discrete = action_space.kind == "discrete"
+        self.dist = Categorical if self.discrete else DiagGaussian
+        num_outputs = (action_space.n if self.discrete
+                       else 2 * int(np.prod(action_space.shape)))
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = ac_init(init_rng, obs_dim, num_outputs,
+                              tuple(config.get("hiddens", (64, 64))))
+        import optax
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
+            optax.adam(config.get("lr", 1e-3)))
+        self.opt_state = self._tx.init(self.params)
+
+        dist = self.dist
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+
+        @jax.jit
+        def _act(params, rng, obs):
+            pi, v = ac_forward(params, obs)
+            actions = dist.sample(rng, pi)
+            return actions, dist.logp(pi, actions), v
+        self._act = _act
+
+        def _loss(params, batch):
+            pi, v = ac_forward(params, batch[OBS])
+            logp = dist.logp(pi, batch[ACTIONS])
+            pg = -jnp.mean(logp * batch[ADVANTAGES])
+            vf = jnp.mean((v - batch[VALUE_TARGETS]) ** 2)
+            ent = jnp.mean(dist.entropy(pi))
+            total = pg + vf_coeff * vf - ent_coeff * ent
+            return total, {"policy_loss": pg, "vf_loss": vf,
+                           "entropy": ent, "total_loss": total}
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(
+                _loss, has_aux=True)(params, batch)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            import optax as _ox
+            params = _ox.apply_updates(params, updates)
+            return params, opt_state, stats
+        self._update = _update
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._rng, rng = jax.random.split(self._rng)
+        actions, logp, v = self._act(self.params, rng,
+                                     jnp.asarray(obs, jnp.float32))
+        return {ACTIONS: np.asarray(actions), ACTION_LOGP: np.asarray(logp),
+                VF_PREDS: np.asarray(v)}
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        _, v = ac_forward(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(v)
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        adv = np.asarray(batch[ADVANTAGES], np.float32)
+        batch = dict(batch)
+        batch[ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        device_batch = {
+            k: jnp.asarray(np.asarray(v, np.float32 if k != ACTIONS
+                                      else None))
+            for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, device_batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class A2C(Algorithm):
+    def __init__(self, config=None, **kwargs):
+        config = dict(config or {})
+        config.setdefault("policy", "a2c")
+        super().__init__(config=config, **kwargs)
+
+    def training_step(self) -> Dict[str, Any]:
+        train_batch = self.workers.synchronous_sample()
+        self._timesteps_total += train_batch.count
+        stats = self.workers.local_worker.policy.learn_on_batch(train_batch)
+        self.workers.sync_weights()
+        return {"info": {"learner": stats},
+                "train_batch_size": train_batch.count,
+                **{f"learner_{k}": v for k, v in stats.items()}}

@@ -26,7 +26,9 @@ from ray_tpu.rllib.policy import Categorical, DiagGaussian, Policy, \
     _orthogonal
 from ray_tpu.rllib.sample_batch import (ACTIONS, ACTION_LOGP, ADVANTAGES,
                                         OBS, VALUE_TARGETS, VF_PREDS)
-from ray_tpu.rllib.recurrent import RESETS, STATE_IN
+from ray_tpu.rllib.recurrent import (RESETS, STATE_IN,  # noqa: F401
+                                     StatefulPPOPolicy,
+                                     masked_seq_forward)
 
 
 class ModelCatalog:
@@ -104,140 +106,23 @@ def attn_seq_forward(params: Dict, state0: jax.Array, obs: jax.Array,
                      resets: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Time-major [T, n, D] forward with in-scan episode resets (same
     contract as lstm_seq_forward)."""
-
-    def body(state, inp):
-        o_t, r_t = inp
-        state = state * (1.0 - r_t)[:, None, None]
-        pi, v, state = attn_step(params, state, o_t)
-        return state, (pi, v)
-
-    _, (pi, v) = jax.lax.scan(body, state0, (obs, resets))
-    return pi, v
+    return masked_seq_forward(attn_step, params, state0, obs, resets)
 
 
-class AttentionPPOPolicy(Policy):
-    """PPO over the windowed-attention memory core; trains on [T, n]
-    fragments with the same state plumbing as RecurrentPPOPolicy."""
+class AttentionPPOPolicy(StatefulPPOPolicy):
+    """PPO over the windowed-attention memory core; all PPO machinery
+    (jitted act/update, fragment loss, state plumbing) comes from
+    StatefulPPOPolicy — only the core differs."""
 
-    recurrent = True
-
-    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
-                 seed: int = 0):
-        self.config = config
-        self.discrete = action_space.kind == "discrete"
-        self.dist = Categorical if self.discrete else DiagGaussian
-        num_outputs = (action_space.n if self.discrete
-                       else 2 * int(np.prod(action_space.shape)))
+    def _init_params(self, rng, obs_dim, num_outputs, config):
         model = config.get("model") or {}
         self.embed = int(model.get("attention_dim", 64))
         self.memory = int(model.get("attention_memory", 8))
-        self._rng = jax.random.PRNGKey(seed)
-        self._rng, init_rng = jax.random.split(self._rng)
-        self.params = attn_init(init_rng, obs_dim, num_outputs,
-                                embed=self.embed, memory=self.memory)
-        import optax
-        self._tx = optax.chain(
-            optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
-            optax.adam(config.get("lr", 3e-4)))
-        self.opt_state = self._tx.init(self.params)
-        self._state = None
-        dist = self.dist
+        return attn_init(rng, obs_dim, num_outputs,
+                         embed=self.embed, memory=self.memory)
 
-        @jax.jit
-        def _act(params, rng, state, obs):
-            pi, v, state = attn_step(params, state, obs)
-            actions = dist.sample(rng, pi)
-            return actions, dist.logp(pi, actions), v, state
-        self._act = _act
+    def _step_fn(self):
+        return attn_step
 
-        clip = config.get("clip_param", 0.2)
-        vf_coeff = config.get("vf_loss_coeff", 0.5)
-        ent_coeff = config.get("entropy_coeff", 0.01)
-        num_epochs = config.get("num_sgd_iter", 4)
-
-        def _loss(params, batch):
-            pi, v = attn_seq_forward(params, batch[STATE_IN], batch[OBS],
-                                     batch[RESETS])
-            T, n = v.shape
-            flat_pi = pi.reshape((T * n,) + pi.shape[2:])
-            acts = batch[ACTIONS].reshape((T * n,)
-                                          + batch[ACTIONS].shape[2:])
-            logp = dist.logp(flat_pi, acts).reshape(T, n)
-            ratio = jnp.exp(logp - batch[ACTION_LOGP])
-            adv = batch[ADVANTAGES]
-            surr = jnp.minimum(ratio * adv,
-                               jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-            vf_err = (v - batch[VALUE_TARGETS]) ** 2
-            entropy = dist.entropy(flat_pi)
-            total = (-jnp.mean(surr) + vf_coeff * jnp.mean(vf_err)
-                     - ent_coeff * jnp.mean(entropy))
-            return total, {"policy_loss": -jnp.mean(surr),
-                           "vf_loss": jnp.mean(vf_err),
-                           "entropy": jnp.mean(entropy),
-                           "total_loss": total}
-
-        @jax.jit
-        def _update(params, opt_state, batch):
-            import optax as _optax
-
-            def epoch(carry, _):
-                params, opt_state = carry
-                (_, stats), grads = jax.value_and_grad(
-                    _loss, has_aux=True)(params, batch)
-                updates, opt_state = self._tx.update(grads, opt_state)
-                params = _optax.apply_updates(params, updates)
-                return (params, opt_state), stats
-
-            (params, opt_state), stats = jax.lax.scan(
-                epoch, (params, opt_state), jnp.arange(num_epochs))
-            return params, opt_state, jax.tree.map(lambda s: s[-1], stats)
-        self._update = _update
-
-    # -- rollout side (same contract the rollout worker drives) ----------
-
-    def _ensure_state(self, n: int):
-        if self._state is None or self._state.shape[0] != n:
-            self._state = jnp.zeros((n, self.memory, self.embed),
-                                    jnp.float32)
-
-    def state_snapshot(self) -> np.ndarray:
-        return np.asarray(self._state)
-
-    def notify_dones(self, done: np.ndarray) -> None:
-        if done.any():
-            mask = jnp.asarray(~done, jnp.float32)[:, None, None]
-            self._state = self._state * mask
-
-    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
-        self._ensure_state(obs.shape[0])
-        self._rng, rng = jax.random.split(self._rng)
-        actions, logp, v, self._state = self._act(
-            self.params, rng, self._state, jnp.asarray(obs, jnp.float32))
-        return {ACTIONS: np.asarray(actions),
-                ACTION_LOGP: np.asarray(logp), VF_PREDS: np.asarray(v)}
-
-    def compute_values(self, obs: np.ndarray) -> np.ndarray:
-        self._ensure_state(obs.shape[0])
-        _, v, _ = attn_step(self.params, self._state,
-                            jnp.asarray(obs, jnp.float32))
-        return np.asarray(v)
-
-    # -- learner side -----------------------------------------------------
-
-    def learn_on_batch(self, batch) -> Dict[str, float]:
-        adv = np.asarray(batch[ADVANTAGES], np.float32)
-        batch = dict(batch)
-        batch[ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
-        device_batch = {
-            k: jnp.asarray(np.asarray(
-                v, None if k == ACTIONS else np.float32))
-            for k, v in batch.items()}
-        self.params, self.opt_state, stats = self._update(
-            self.params, self.opt_state, device_batch)
-        return {k: float(v) for k, v in stats.items()}
-
-    def get_weights(self):
-        return jax.tree.map(np.asarray, self.params)
-
-    def set_weights(self, weights):
-        self.params = jax.tree.map(jnp.asarray, weights)
+    def _state_shape(self):
+        return (self.memory, self.embed)

@@ -83,10 +83,19 @@ class DatasetReader:
         return SampleBatch.concat_samples(
             [self._load(s) for s in self._shards])
 
-    def iter_batches(self, batch_size: int) -> Iterator[SampleBatch]:
-        """Infinite minibatch stream over the whole dataset."""
-        data = self.read_all()
+    def iter_batches(self, batch_size: int,
+                     data: Optional[SampleBatch] = None
+                     ) -> Iterator[SampleBatch]:
+        """Infinite minibatch stream over the whole dataset.  ``data``
+        overrides the source batch (MARWIL passes the dataset with its
+        precomputed returns column attached)."""
+        if data is None:
+            data = self.read_all()
         n = data.count
+        # A dataset smaller than one batch still yields (the whole thing,
+        # shuffled) — range() would otherwise be empty and the generator
+        # would spin forever without yielding.
+        batch_size = min(batch_size, n)
         while True:
             idx = (self._rng.permutation(n) if self.shuffle
                    else np.arange(n))
@@ -378,6 +387,161 @@ class CQL(Algorithm):
             self._timesteps_total += b.count
         if self.iteration % self.config.get("target_update_freq", 8) == 0:
             self._target = jax.tree.map(jnp.asarray, policy.params)
+        self.workers.sync_weights()
+        self.workers.synchronous_sample()   # evaluation metrics
+        return {"info": {"learner": stats},
+                **{f"learner_{k}": v for k, v in stats.items()}}
+
+
+# --------------------------------------------------------------- MARWIL
+
+def compute_mc_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-episode Monte-Carlo discounted returns over row-ordered data
+    (episodes cut at dones; a truncated final segment is treated as an
+    episode).  Reference analog: postprocessing.compute_advantages with
+    use_gae=False, use_critic=False."""
+    returns = np.zeros_like(rewards, dtype=np.float64)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        returns[t] = acc
+    return returns.astype(np.float32)
+
+
+class MARWILPolicy(BCPolicy):
+    """Monotonic advantage re-weighted imitation learning.
+
+    BC weighted by exp(beta * advantage): the value head estimates V(s),
+    advantage = MC-return - V, and the exp weight focuses cloning on
+    better-than-average trajectories.  beta=0 degrades exactly to BC
+    (reference: ``rllib/algorithms/marwil/marwil.py`` — its BC subclass
+    is literally beta=0).  The advantage-norm moving average that keeps
+    exp() in range is carried as policy state, like the reference's
+    ``update_averaged_estimate``.
+    """
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        super().__init__(obs_dim, action_space, config, seed=seed)
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.policy import ac_forward
+        dist = self.dist
+        beta = config.get("beta", 1.0)
+        vf_coeff = config.get("vf_coeff", 1.0)
+        ma_rate = config.get("moving_average_sqd_adv_norm_update_rate",
+                             1e-2)
+        self._ma_adv_sq = jnp.asarray(
+            config.get("moving_average_sqd_adv_norm_start", 100.0))
+
+        @jax.jit
+        def _update(params, opt_state, ma_adv_sq, obs, actions, returns):
+            def loss(p):
+                pi, v = ac_forward(p, obs)
+                adv = returns - v
+                adv_sg = jax.lax.stop_gradient(adv)
+                # exp-weight with the advantage normalized by the moving
+                # RMS; clip for numerical safety like the reference.
+                w = jnp.exp(jnp.clip(
+                    beta * adv_sg / jnp.sqrt(ma_adv_sq + 1e-8),
+                    -3.0, 3.0))
+                pg = -jnp.mean(jax.lax.stop_gradient(w)
+                               * dist.logp(pi, actions))
+                vf = jnp.mean(adv ** 2)
+                return pg + vf_coeff * vf, (pg, vf, adv_sg)
+
+            (l, (pg, vf, adv)), grads = jax.value_and_grad(
+                loss, has_aux=True)(params)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            ma_adv_sq = ma_adv_sq + ma_rate * (
+                jnp.mean(adv ** 2) - ma_adv_sq)
+            return params, opt_state, ma_adv_sq, l, pg, vf
+        self._marwil_update = _update
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax.numpy as jnp
+        if "returns" not in batch:
+            raise ValueError("MARWIL batches need a 'returns' column "
+                             "(MARWIL.setup precomputes it)")
+        (self.params, self.opt_state, self._ma_adv_sq, l, pg,
+         vf) = self._marwil_update(
+            self.params, self.opt_state, self._ma_adv_sq,
+            jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            jnp.asarray(np.asarray(batch[ACTIONS])),
+            jnp.asarray(np.asarray(batch["returns"], np.float32)))
+        return {"marwil_loss": float(l), "policy_loss": float(pg),
+                "vf_loss": float(vf)}
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(MARWIL)
+        self._config.update({
+            "policy": "marwil",
+            "input": None,
+            "beta": 1.0,
+            "vf_coeff": 1.0,
+            "gamma": 0.99,
+            "train_batch_size": 512,
+            "sgd_iters_per_step": 16,
+            "lr": 1e-3,
+            "hiddens": (64, 64),
+            "num_rollout_workers": 0,
+        })
+
+    def offline_data(self, *, input: str) -> "MARWILConfig":  # noqa: A002
+        self._config["input"] = input
+        return self
+
+
+class MARWIL(Algorithm):
+    """Offline advantage-weighted cloning from a logged dataset.
+
+    MC returns are computed ONCE over the row-ordered dataset (before any
+    shuffling — episode structure is positional) and carried as an extra
+    column through the minibatch stream.
+    """
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "marwil")
+        super().setup(config)
+        if not config.get("input"):
+            raise ValueError("MARWIL requires config['input'] "
+                             "(dataset dir)")
+        reader = DatasetReader(config["input"], seed=config.get("seed", 0))
+        data = reader.read_all()
+        returns = compute_mc_returns(
+            np.asarray(data[REWARDS], np.float64),
+            np.asarray(data[DONES]).astype(bool),
+            config.get("gamma", 0.99))
+        # z-score once over the dataset: the value head then regresses an
+        # O(1) target, so its gradient through the shared trunk can't
+        # drown the cloning term, and advantages start in exp()'s sweet
+        # spot.  (Weighting is scale-free — only relative adv matters.)
+        returns = ((returns - returns.mean())
+                   / (returns.std() + 1e-8)).astype(np.float32)
+        cols = dict(data)
+        cols["returns"] = returns
+        self._reader = reader
+        self._data = SampleBatch(cols)
+
+    def training_step(self) -> Dict[str, Any]:
+        if not hasattr(self, "_batches"):
+            self._batches = self._reader.iter_batches(
+                self.config.get("train_batch_size", 512), data=self._data)
+        policy = self.workers.local_worker.policy
+        stats: Dict[str, float] = {}
+        for _ in range(self.config.get("sgd_iters_per_step", 16)):
+            batch = next(self._batches)
+            stats = policy.learn_on_batch(batch)
+            self._timesteps_total += batch.count
         self.workers.sync_weights()
         self.workers.synchronous_sample()   # evaluation metrics
         return {"info": {"learner": stats},

@@ -70,9 +70,11 @@ def lstm_step(params: Dict, state: jax.Array, obs: jax.Array
     return pi, v, jnp.stack([h, c], axis=1)
 
 
-def lstm_seq_forward(params: Dict, state0: jax.Array, obs: jax.Array,
-                     resets: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Time-major sequence forward with in-scan episode resets.
+def masked_seq_forward(step_fn, params: Dict, state0: jax.Array,
+                       obs: jax.Array, resets: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Time-major sequence forward with in-scan episode resets, generic
+    over the per-step core (LSTM, attention ring, ...).
 
     obs [T, n, D], resets [T, n] (1.0 zeroes the carried state before
     consuming obs[t] — i.e. env n finished at t-1).  -> pi [T, n, O],
@@ -81,17 +83,29 @@ def lstm_seq_forward(params: Dict, state0: jax.Array, obs: jax.Array,
     def body(state, inp):
         o_t, r_t = inp
         state = state * (1.0 - r_t)[:, None, None]
-        pi, v, state = lstm_step(params, state, o_t)
+        pi, v, state = step_fn(params, state, o_t)
         return state, (pi, v)
 
     _, (pi, v) = jax.lax.scan(body, state0, (obs, resets))
     return pi, v
 
 
+def lstm_seq_forward(params, state0, obs, resets):
+    return masked_seq_forward(lstm_step, params, state0, obs, resets)
+
+
 # -- policy ---------------------------------------------------------------
 
-class RecurrentPPOPolicy(Policy):
-    """PPO over an LSTM core; trains on [T, n] fragments.
+class StatefulPPOPolicy(Policy):
+    """Shared PPO machinery for policies with a carried per-env state
+    (LSTM core, attention-memory core); trains on [T, n] fragments.
+
+    Subclasses provide the core: ``_init_params(rng, obs_dim,
+    num_outputs, config)``, ``_step_fn()`` (the (params, state, obs) ->
+    (pi, v, state) function), and ``_state_shape()`` (trailing dims of
+    the per-env state).  Everything else — the jitted act fn, the
+    sequence loss over ``masked_seq_forward``, the epoch-scanned update,
+    the rollout-side state plumbing — lives here once.
 
     The update is one jitted program: epochs x full-fragment gradient
     steps (sequences cannot be flat-shuffled — minibatching, when the env
@@ -100,6 +114,16 @@ class RecurrentPPOPolicy(Policy):
 
     recurrent = True
 
+    def _init_params(self, rng, obs_dim: int, num_outputs: int,
+                     config: Dict[str, Any]):
+        raise NotImplementedError
+
+    def _step_fn(self):
+        raise NotImplementedError
+
+    def _state_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
     def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
                  seed: int = 0):
         self.config = config
@@ -107,13 +131,12 @@ class RecurrentPPOPolicy(Policy):
         self.dist = Categorical if self.discrete else DiagGaussian
         num_outputs = (action_space.n if self.discrete
                        else 2 * int(np.prod(action_space.shape)))
-        hidden = int(config.get("lstm_cell_size", 64))
-        self.hidden = hidden
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(self._rng)
-        self.params = lstm_init(init_rng, obs_dim, num_outputs,
-                                embed=int(config.get("lstm_embed", 64)),
-                                hidden=hidden)
+        self.params = self._init_params(init_rng, obs_dim, num_outputs,
+                                        config)
+        step_fn = self._step_fn()
+        self._step = step_fn
         import optax
         self._tx = optax.chain(
             optax.clip_by_global_norm(config.get("grad_clip", 0.5)),
@@ -125,7 +148,7 @@ class RecurrentPPOPolicy(Policy):
 
         @jax.jit
         def _act(params, rng, state, obs):
-            pi, v, state = lstm_step(params, state, obs)
+            pi, v, state = step_fn(params, state, obs)
             actions = dist.sample(rng, pi)
             return actions, dist.logp(pi, actions), v, state
         self._act = _act
@@ -136,8 +159,8 @@ class RecurrentPPOPolicy(Policy):
         num_epochs = config.get("num_sgd_iter", 4)
 
         def _loss(params, batch):
-            pi, v = lstm_seq_forward(params, batch[STATE_IN], batch[OBS],
-                                     batch[RESETS])
+            pi, v = masked_seq_forward(step_fn, params, batch[STATE_IN],
+                                       batch[OBS], batch[RESETS])
             T, n = v.shape
             flat_pi = pi.reshape((T * n,) + pi.shape[2:])
             acts = batch[ACTIONS].reshape((T * n,)
@@ -176,7 +199,8 @@ class RecurrentPPOPolicy(Policy):
 
     def _ensure_state(self, n: int):
         if self._state is None or self._state.shape[0] != n:
-            self._state = jnp.zeros((n, 2, self.hidden), jnp.float32)
+            self._state = jnp.zeros((n,) + self._state_shape(),
+                                    jnp.float32)
 
     def state_snapshot(self) -> np.ndarray:
         return np.asarray(self._state)
@@ -198,8 +222,8 @@ class RecurrentPPOPolicy(Policy):
     def compute_values(self, obs: np.ndarray) -> np.ndarray:
         """Value at the CURRENT state without advancing it (bootstrap)."""
         self._ensure_state(obs.shape[0])
-        _, v, _ = lstm_step(self.params, self._state,
-                            jnp.asarray(obs, jnp.float32))
+        _, v, _ = self._step(self.params, self._state,
+                             jnp.asarray(obs, jnp.float32))
         return np.asarray(v)
 
     # -- learner side -----------------------------------------------------
@@ -221,3 +245,19 @@ class RecurrentPPOPolicy(Policy):
 
     def set_weights(self, weights):
         self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class RecurrentPPOPolicy(StatefulPPOPolicy):
+    """PPO over the fused-gate LSTM core (reference LSTMWrapper)."""
+
+    def _init_params(self, rng, obs_dim, num_outputs, config):
+        self.hidden = int(config.get("lstm_cell_size", 64))
+        return lstm_init(rng, obs_dim, num_outputs,
+                         embed=int(config.get("lstm_embed", 64)),
+                         hidden=self.hidden)
+
+    def _step_fn(self):
+        return lstm_step
+
+    def _state_shape(self):
+        return (2, self.hidden)

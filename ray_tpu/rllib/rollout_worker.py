@@ -45,6 +45,15 @@ def _resolve_policy_class(name: str):
     if name == "bc":
         from ray_tpu.rllib.offline import BCPolicy
         return BCPolicy
+    if name == "marwil":
+        from ray_tpu.rllib.offline import MARWILPolicy
+        return MARWILPolicy
+    if name == "a2c":
+        from ray_tpu.rllib.a2c import A2CPolicy
+        return A2CPolicy
+    if name == "td3":
+        from ray_tpu.rllib.td3 import TD3Policy
+        return TD3Policy
     raise ValueError(f"unknown policy {name!r}")
 
 

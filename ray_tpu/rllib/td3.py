@@ -1,0 +1,215 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+Design analog: reference ``rllib/algorithms/td3/td3.py`` (DDPG +
+the three TD3 fixes: twin critics, delayed policy updates, target policy
+smoothing).  TPU-first: the whole update — both critics every step, actor
++ targets every ``policy_delay`` steps via lax.cond — is ONE jitted
+program; exploration noise is explicit-PRNG Gaussian on the host side of
+the actor.  Shares the replay-driven Algorithm shape with SAC/DQN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sac import _mlp, _mlp_init, _q_forward
+from ray_tpu.rllib.sample_batch import (ACTIONS, DONES, NEXT_OBS, OBS,
+                                        REWARDS, SampleBatch)
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(TD3)
+        self._config.update({
+            "policy": "td3",
+            "hiddens": (64, 64),
+            "actor_lr": 1e-3,
+            "critic_lr": 1e-3,
+            "tau": 0.005,
+            "policy_delay": 2,
+            "exploration_noise": 0.1,       # of action scale, rollout side
+            "target_noise": 0.2,            # smoothing noise on targets
+            "target_noise_clip": 0.5,
+            "train_batch_size": 256,
+            "buffer_size": 100_000,
+            "learning_starts": 1500,
+            "num_train_iters": 8,
+            "rollout_fragment_length": 8,
+            "num_envs_per_worker": 8,
+            "gamma": 0.99,
+        })
+
+
+class TD3Policy(Policy):
+    replay_style = True
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        if action_space.kind != "box":
+            raise ValueError("TD3 requires a continuous (box) action space")
+        self.config = config
+        act_dim = int(np.prod(action_space.shape)) or 1
+        self.act_dim = act_dim
+        self.act_scale = float(action_space.high)
+        hid = tuple(config.get("hiddens", (64, 64)))
+        key = jax.random.PRNGKey(seed)
+        ka, k1, k2 = jax.random.split(key, 3)
+        actor = _mlp_init(ka, (obs_dim,) + hid + (act_dim,))
+        q1 = _mlp_init(k1, (obs_dim + act_dim,) + hid + (1,))
+        q2 = _mlp_init(k2, (obs_dim + act_dim,) + hid + (1,))
+        self.params = {"actor": actor, "q1": q1, "q2": q2}
+        self.target = jax.tree.map(jnp.copy, self.params)
+
+        import optax
+        self._tx = {"actor": optax.adam(config.get("actor_lr", 1e-3)),
+                    "critic": optax.adam(config.get("critic_lr", 1e-3))}
+        self.opt_state = {
+            "actor": self._tx["actor"].init(actor),
+            "critic": self._tx["critic"].init({"q1": q1, "q2": q2}),
+        }
+        self._key = jax.random.PRNGKey(seed + 7)
+        self._updates = 0
+        gamma = config.get("gamma", 0.99)
+        tau = config.get("tau", 0.005)
+        delay = config.get("policy_delay", 2)
+        scale = self.act_scale
+        expl = config.get("exploration_noise", 0.1) * scale
+        tnoise = config.get("target_noise", 0.2) * scale
+        tclip = config.get("target_noise_clip", 0.5) * scale
+
+        def _pi(actor, obs):
+            return jnp.tanh(_mlp(actor, obs)) * scale
+
+        @jax.jit
+        def _act(actor, obs, key, deterministic):
+            a = _pi(actor, obs)
+            noise = expl * jax.random.normal(key, a.shape)
+            return jnp.where(deterministic, a,
+                             jnp.clip(a + noise, -scale, scale))
+        self._act_fn = _act
+
+        @jax.jit
+        def _update(params, target, opt_state, batch, key, step):
+            # -- twin-critic update with target policy smoothing
+            noise = jnp.clip(
+                tnoise * jax.random.normal(key, (batch[OBS].shape[0],
+                                                 act_dim)),
+                -tclip, tclip)
+            a_next = jnp.clip(_pi(target["actor"], batch[NEXT_OBS]) + noise,
+                              -scale, scale)
+            qn = jnp.minimum(
+                _q_forward(target["q1"], batch[NEXT_OBS], a_next),
+                _q_forward(target["q2"], batch[NEXT_OBS], a_next))
+            backup = jax.lax.stop_gradient(
+                batch[REWARDS] + gamma
+                * (1.0 - batch[DONES].astype(jnp.float32)) * qn)
+
+            def critic_loss(qs):
+                l1 = jnp.mean((_q_forward(qs["q1"], batch[OBS],
+                                          batch[ACTIONS]) - backup) ** 2)
+                l2 = jnp.mean((_q_forward(qs["q2"], batch[OBS],
+                                          batch[ACTIONS]) - backup) ** 2)
+                return l1 + l2
+
+            qs = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(qs)
+            cupd, opt_c = self._tx["critic"].update(
+                cgrads, opt_state["critic"])
+            import optax as _ox
+            qs = _ox.apply_updates(qs, cupd)
+
+            # -- delayed actor + target updates (lax.cond keeps the whole
+            # step one compiled program; the predicate is a traced scalar)
+            def do_actor(_):
+                def actor_loss(actor):
+                    a = _pi(actor, batch[OBS])
+                    return -jnp.mean(_q_forward(qs["q1"], batch[OBS], a))
+                aloss, agrads = jax.value_and_grad(actor_loss)(
+                    params["actor"])
+                aupd, opt_a = self._tx["actor"].update(
+                    agrads, opt_state["actor"])
+                actor = _ox.apply_updates(params["actor"], aupd)
+                new = {"actor": actor, **qs}
+                tgt = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                   target, new)
+                return actor, opt_a, tgt, aloss
+
+            def skip_actor(_):
+                return (params["actor"], opt_state["actor"], target,
+                        jnp.zeros(()))
+
+            actor, opt_a, target_new, aloss = jax.lax.cond(
+                step % delay == 0, do_actor, skip_actor, operand=None)
+            params = {"actor": actor, "q1": qs["q1"], "q2": qs["q2"]}
+            opt_state = {"actor": opt_a, "critic": opt_c}
+            stats = {"critic_loss": closs, "actor_loss": aloss,
+                     "mean_q": jnp.mean(backup)}
+            return params, target_new, opt_state, stats
+        self._update = _update
+
+    # -- rollout side -----------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        self._key, k = jax.random.split(self._key)
+        a = self._act_fn(self.params["actor"],
+                         jnp.asarray(obs, jnp.float32), k, False)
+        return {ACTIONS: np.asarray(a, np.float32)}
+
+    # -- learner side -----------------------------------------------------
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        device_batch = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            NEXT_OBS: jnp.asarray(np.asarray(batch[NEXT_OBS], np.float32)),
+            ACTIONS: jnp.asarray(
+                np.asarray(batch[ACTIONS], np.float32).reshape(
+                    batch.count, self.act_dim)),
+            REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+            DONES: jnp.asarray(np.asarray(batch[DONES])),
+        }
+        self._key, k = jax.random.split(self._key)
+        self.params, self.target, self.opt_state, stats = self._update(
+            self.params, self.target, self.opt_state, device_batch, k,
+            jnp.asarray(self._updates, jnp.int32))
+        self._updates += 1
+        return {k2: float(v) for k2, v in stats.items()}
+
+    def update_target(self):
+        pass  # polyak-averaged inside the delayed update
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class TD3(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "td3")
+        super().setup(config)
+        self.replay = ReplayBuffer(config.get("buffer_size", 100_000),
+                                   seed=config.get("seed", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        batch = self.workers.synchronous_sample()
+        self._timesteps_total += batch.count
+        self.replay.add(batch)
+        stats: Dict[str, Any] = {}
+        policy = self.workers.local_worker.policy
+        if len(self.replay) >= c.get("learning_starts", 1500):
+            for _ in range(c.get("num_train_iters", 8)):
+                train = self.replay.sample(c.get("train_batch_size", 256))
+                stats = policy.learn_on_batch(train)
+            self.workers.sync_weights()
+        return {"info": {"learner": stats}, **stats}

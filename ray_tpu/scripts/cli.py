@@ -202,6 +202,27 @@ def cmd_usage(args):
     print(json.dumps(ray_tpu.usage_report(), indent=2, default=str))
 
 
+def cmd_debug(args):
+    """List active rpdb sessions and attach (reference: ``ray debug``)."""
+    _connect(args)
+    from ray_tpu.util import rpdb
+    sessions = rpdb.list_sessions()
+    if not sessions:
+        print("no active debugger sessions")
+        return
+    for i, s in enumerate(sessions):
+        print(f"[{i}] pid {s['pid']}  {s['function']} at "
+              f"{s['filename']}:{s['lineno']}  ({s['host']}:{s['port']})")
+    idx = args.index
+    if idx is None:
+        if len(sessions) == 1:
+            idx = 0
+        else:
+            idx = int(input("attach to which session? "))
+    print(f"attaching to [{idx}]; type 'c' to continue the task")
+    rpdb.connect(sessions[idx])
+
+
 # --------------------------------------------------------------------- jobs
 
 
@@ -286,6 +307,13 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="run the perf microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("debug",
+                        help="attach to an rpdb breakpoint in a worker")
+    sp.add_argument("--address")
+    sp.add_argument("--index", type=int, default=None,
+                    help="session index (default: prompt, or 0 if single)")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("job")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

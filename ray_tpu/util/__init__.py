@@ -8,3 +8,4 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
 )
 from ray_tpu.util.object_broadcast import broadcast  # noqa: F401
+from ray_tpu.util import rpdb  # noqa: F401  (ray.util.rpdb analog)

@@ -95,8 +95,15 @@ class ClientProxyServer:
                  "RT_CLIENT_SESSION_ID": client_id},
             stdout=subprocess.PIPE, text=True)
         loop = asyncio.get_running_loop()
-        line = await asyncio.wait_for(
-            loop.run_in_executor(None, proc.stdout.readline), timeout=60)
+        try:
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, proc.stdout.readline), timeout=60)
+        except asyncio.TimeoutError:
+            # Kill the stalled child or it lives forever (its idle-grace
+            # loop never starts before SESSION_READY) and the executor
+            # thread stays stuck in readline until EOF.
+            proc.kill()
+            return {"ok": False, "error": "session spawn timed out"}
         if not line.startswith("SESSION_READY "):
             proc.kill()
             return {"ok": False,

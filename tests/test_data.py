@@ -240,3 +240,55 @@ def test_random_access_dataset_point_lookups(ray_start):
     assert [g["val"] if g else None for g in got] == [9, 9801, 1681, None]
     st = rad.stats()
     assert st["num_partitions"] == 2 and sum(st["rows_per_partition"]) == 100
+
+
+def test_groupby_aggregations(ray_start):
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rd.from_items(rows).repartition(4)
+    got = {r["k"]: r for r in ds.groupby("k").aggregate(
+        rd.Count(), rd.Sum("v"), rd.Min("v"), rd.Max("v"),
+        rd.Mean("v"), rd.Std("v")).take_all()}
+    assert set(got) == {0, 1, 2}
+    for k in range(3):
+        vals = [float(i) for i in range(30) if i % 3 == k]
+        r = got[k]
+        assert r["count()"] == 10
+        assert r["sum(v)"] == sum(vals)
+        assert r["min(v)"] == min(vals) and r["max(v)"] == max(vals)
+        assert abs(r["mean(v)"] - np.mean(vals)) < 1e-9
+        assert abs(r["std(v)"] - np.std(vals, ddof=1)) < 1e-9
+
+
+def test_groupby_callable_key_and_global_group(ray_start):
+    ds = rd.from_items(list(range(20))).repartition(3)
+    # Callable key: parity classes.
+    out = {r["key"]: r["count()"] for r in
+           ds.groupby(lambda x: x % 2).count().take_all()}
+    assert out == {0: 10, 1: 10}
+    # key=None: one global group.
+    [row] = ds.groupby(None).sum().take_all()
+    assert row["sum()"] == sum(range(20))
+
+
+def test_groupby_map_groups(ray_start):
+    rows = [{"g": "a" if i < 6 else "b", "v": i} for i in range(10)]
+    ds = rd.from_items(rows).repartition(2)
+
+    def top1(group_rows):
+        best = max(group_rows, key=lambda r: r["v"])
+        return [{"g": best["g"], "best": best["v"]}]
+
+    got = sorted(ds.groupby("g").map_groups(top1).take_all(),
+                 key=lambda r: r["g"])
+    assert got == [{"g": "a", "best": 5}, {"g": "b", "best": 9}]
+
+
+def test_groupby_custom_aggregate_fn(ray_start):
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(8)])
+    prod = rd.AggregateFn(
+        init=lambda k: 1,
+        accumulate=lambda a, r: a * (r["v"] + 1),
+        name="prod(v+1)")
+    got = {r["k"]: r["prod(v+1)"] for r in
+           ds.groupby("k").aggregate(prod).take_all()}
+    assert got[0] == 1 * 3 * 5 * 7 and got[1] == 2 * 4 * 6 * 8
