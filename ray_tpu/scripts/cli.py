@@ -223,6 +223,27 @@ def cmd_debug(args):
     rpdb.connect(sessions[idx])
 
 
+def cmd_serve(args):
+    """serve deploy/status/delete/shutdown (reference: serve CLI in
+    python/ray/serve/scripts.py over the REST schema)."""
+    _connect(args)
+    from ray_tpu import serve
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import (ServeApplicationSchema,
+                                          deploy_application)
+        st = deploy_application(ServeApplicationSchema.from_file(
+            args.config_file))
+        print(json.dumps(st, indent=2, default=str))
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "delete":
+        serve.delete(args.name)
+        print(f"deleted: {args.name}")
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 # --------------------------------------------------------------------- jobs
 
 
@@ -307,6 +328,19 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="run the perf microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("serve", help="manage Serve deployments")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy")
+    s.add_argument("config_file", help="YAML/JSON application config")
+    s.add_argument("--address")
+    for name in ("status", "shutdown"):
+        s = ssub.add_parser(name)
+        s.add_argument("--address")
+    s = ssub.add_parser("delete")
+    s.add_argument("name")
+    s.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("debug",
                         help="attach to an rpdb breakpoint in a worker")

@@ -282,3 +282,59 @@ def test_long_poll_push_beats_ttl(serve_cluster):
     # so a bumped version proves the push path delivered the update.
     assert h._version >= 1
     assert router_mod.REFRESH_PERIOD_S >= 30.0
+
+
+def test_declarative_schema_deploy(serve_cluster, tmp_path):
+    """serve deploy path: YAML config -> import_path resolution ->
+    options override -> running deployment (reference: serve deploy +
+    ServeApplicationSchema)."""
+    from ray_tpu.serve.schema import (ServeApplicationSchema,
+                                      deploy_application)
+    mod = tmp_path / "my_app.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment(ray_actor_options={'num_cpus': 0.1})\n"
+        "class Echo:\n"
+        "    def __init__(self, prefix='x'):\n"
+        "        self.prefix = prefix\n"
+        "    def __call__(self, s):\n"
+        "        return self.prefix + str(s)\n")
+    import sys
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = {
+            "deployments": [{
+                "name": "EchoSvc",
+                "import_path": "my_app:Echo",
+                "num_replicas": 2,
+                "init_kwargs": {"prefix": "hi:"},
+            }],
+        }
+        schema = ServeApplicationSchema.from_dict(cfg)
+        st = deploy_application(schema)
+        assert st["EchoSvc"]["running"] == 2
+        h = serve.get_handle("EchoSvc")
+        assert ray_tpu.get(h.remote(7)) == "hi:7"
+        serve.delete("EchoSvc")
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_schema_validation_errors(tmp_path):
+    from ray_tpu.serve.schema import ServeApplicationSchema
+    with pytest.raises(ValueError, match="no deployments"):
+        ServeApplicationSchema.from_dict({})
+    with pytest.raises(ValueError, match="unknown deployment config"):
+        ServeApplicationSchema.from_dict(
+            {"deployments": [{"name": "a", "import_path": "m:a",
+                              "replicas": 3}]})
+    with pytest.raises(ValueError, match="duplicate deployment names"):
+        ServeApplicationSchema.from_dict(
+            {"deployments": [{"name": "a", "import_path": "m:a"},
+                             {"name": "a", "import_path": "m:b"}]})
+    # YAML round-trip
+    p = tmp_path / "app.yaml"
+    p.write_text("deployments:\n  - name: a\n    import_path: m:a\n"
+                 "    num_replicas: 3\n")
+    s = ServeApplicationSchema.from_file(str(p))
+    assert s.deployments[0].num_replicas == 3
