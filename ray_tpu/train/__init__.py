@@ -13,6 +13,8 @@ from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, TrainingFailedError
 from ray_tpu.train.batch_predictor import BatchPredictor
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.transformers_trainer import (TransformersTrainer,
+                                                load_model)
 from ray_tpu.train.gbdt_trainer import (GBDTTrainer, SklearnTrainer,
                                         load_estimator)
 from ray_tpu.train.jax.config import JaxConfig
@@ -26,7 +28,7 @@ __all__ = [
     "TrainingFailedError",
     "BatchPredictor",
     "DataParallelTrainer",
-    "GBDTTrainer",
+    "GBDTTrainer", "TransformersTrainer", "load_model",
     "SklearnTrainer",
     "load_estimator",
     "JaxConfig",
