@@ -856,6 +856,52 @@ class Dataset:
         if carry is not None and not drop_last:
             yield self._format_batch(carry, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes: Optional[Dict[str, Any]] = None,
+                           prefetch_blocks: int = 1) -> Iterator[Any]:
+        """Yield torch-tensor batches (reference:
+        ``Dataset.iter_torch_batches``).  Dict batches become dicts of
+        tensors; plain batches a single tensor.  Torch is the host-CPU
+        side path here — device ingest goes through
+        ``iter_device_batches``."""
+        import torch
+
+        def to_t(name, arr):
+            t = torch.as_tensor(np.ascontiguousarray(arr))
+            if dtypes and name in dtypes:
+                t = t.to(dtypes[name])
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last,
+                                       prefetch_blocks=prefetch_blocks):
+            if isinstance(batch, dict):
+                yield {k: to_t(k, v) for k, v in batch.items()}
+            else:
+                yield to_t(None, batch)
+
+    def to_torch(self, *, label_column: Optional[str] = None,
+                 batch_size: int = 256):
+        """IterableDataset view for torch DataLoader-style consumption
+        (reference: ``Dataset.to_torch``).  With ``label_column``, yields
+        (features_dict, label) pairs."""
+        import torch
+
+        ds = self
+
+        class _IterableDS(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for b in ds.iter_torch_batches(batch_size=batch_size):
+                    if label_column is None:
+                        yield b
+                    else:
+                        label = b.pop(label_column)
+                        yield b, label
+
+        return _IterableDS()
+
     def iter_device_batches(self, *, batch_size: int = 256,
                             sharding=None, drop_last: bool = True,
                             prefetch_blocks: int = 2) -> Iterator[Any]:

@@ -1,0 +1,6 @@
+from ray_tpu.train.torch.config import (TorchConfig, prepare_data_loader,
+                                        prepare_model)
+from ray_tpu.train.torch.torch_trainer import TorchTrainer
+
+__all__ = ["TorchConfig", "TorchTrainer", "prepare_data_loader",
+           "prepare_model"]

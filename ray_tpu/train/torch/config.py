@@ -1,0 +1,109 @@
+"""Torch distributed backend: gloo process groups over the worker gang.
+
+Design analog: reference ``python/ray/train/torch/config.py``
+(``_TorchBackend.on_start:132`` -> ``_setup_torch_process_group:69`` ->
+``dist.init_process_group:113``).  On this framework torch is the
+host-CPU side path (the TPU compute path is JAX — see
+``train/jax/config.py``); the gang setup is the same rank-0 TCP
+rendezvous, with gloo instead of NCCL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _setup_torch_process_group(backend: str, init_method: str,
+                               rank: int, world_size: int,
+                               timeout_s: float) -> bool:
+    import datetime
+
+    import torch.distributed as dist
+    if dist.is_initialized():
+        return True
+    dist.init_process_group(
+        backend=backend, init_method=init_method, rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return dist.is_initialized()
+
+
+def _shutdown_torch_process_group():
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig):
+        if len(worker_group) <= 1:
+            return
+        import ray_tpu
+        ip = worker_group.workers[0].ip
+        port = worker_group.execute_single(0, _free_port)
+        init_method = f"tcp://{ip}:{port}"
+        logger.info("torch.distributed %s rendezvous at %s",
+                    backend_config.backend, init_method)
+        refs = [
+            w.actor.execute.remote(
+                _setup_torch_process_group, backend_config.backend,
+                init_method, w.rank, len(worker_group),
+                backend_config.init_timeout_s)
+            for w in worker_group.workers
+        ]
+        ray_tpu.get(refs, timeout=backend_config.init_timeout_s + 30)
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig):
+        try:
+            worker_group.execute(_shutdown_torch_process_group)
+        except Exception:
+            pass
+
+
+def prepare_model(model, parallel_strategy: Optional[str] = "ddp"):
+    """Wrap a torch.nn.Module for data-parallel training (reference:
+    ``train/torch/train_loop_utils.py prepare_model:70`` — DDP wrap; FSDP
+    maps to the JAX fsdp path in this framework, not torch FSDP)."""
+    import torch.distributed as dist
+    if parallel_strategy and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(dataset, batch_size: int, shuffle: bool = True):
+    """DataLoader with a DistributedSampler when a process group is up
+    (reference: train_loop_utils.py prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, DistributedSampler
+    sampler = None
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        sampler = DistributedSampler(dataset)
+    return DataLoader(dataset, batch_size=batch_size, sampler=sampler,
+                      shuffle=shuffle if sampler is None else False)

@@ -1,0 +1,17 @@
+"""TorchTrainer: DataParallelTrainer with the gloo process-group backend.
+
+Design analog: reference ``python/ray/train/torch/torch_trainer.py``.
+The train_loop_per_worker runs inside an initialized torch.distributed
+group; ``prepare_model``/``prepare_data_loader`` give the reference's
+DDP conveniences.  Torch here is the CPU/host path — TPU training goes
+through JaxTrainer.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch.config import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    _backend_config_cls = TorchConfig

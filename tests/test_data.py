@@ -292,3 +292,21 @@ def test_groupby_custom_aggregate_fn(ray_start):
     got = {r["k"]: r["prod(v+1)"] for r in
            ds.groupby("k").aggregate(prod).take_all()}
     assert got[0] == 1 * 3 * 5 * 7 and got[1] == 2 * 4 * 6 * 8
+
+
+def test_iter_torch_batches_and_to_torch(ray_start):
+    import torch
+    rows = [{"x": np.arange(4, dtype=np.float32) + i, "y": float(i)}
+            for i in range(10)]
+    ds = rd.from_items(rows).repartition(2)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    assert sum(b["y"].shape[0] for b in batches) == 10
+    # dtype override
+    b0 = next(iter(ds.iter_torch_batches(batch_size=4,
+                                         dtypes={"y": torch.float64})))
+    assert b0["y"].dtype == torch.float64
+    # IterableDataset with label split
+    it_ds = ds.to_torch(label_column="y", batch_size=5)
+    feats, label = next(iter(it_ds))
+    assert set(feats) == {"x"} and label.shape[0] == 5
