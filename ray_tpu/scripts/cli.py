@@ -223,6 +223,37 @@ def cmd_debug(args):
     rpdb.connect(sessions[idx])
 
 
+_LAUNCHERS: dict = {}   # cluster_name -> ClusterLauncher (this process)
+
+
+def cmd_up(args):
+    """Launch a cluster from a YAML config (reference: ray up)."""
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+    cfg = ClusterConfig.from_file(args.config_file)
+    launcher = ClusterLauncher(cfg)
+    launched = launcher.up(start_monitor=not args.no_monitor)
+    _LAUNCHERS[cfg.cluster_name] = launcher
+    print(json.dumps({"cluster": cfg.cluster_name, "launched": launched}))
+    if not args.no_monitor and not args.no_block:
+        print("autoscaler monitor running; Ctrl-C to tear down")
+        try:
+            import time as _t
+            while True:
+                _t.sleep(3600)
+        except KeyboardInterrupt:
+            n = launcher.down()
+            print(f"terminated {n} nodes")
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+    cfg = ClusterConfig.from_file(args.config_file)
+    launcher = _LAUNCHERS.pop(cfg.cluster_name, None) or \
+        ClusterLauncher(cfg)
+    n = launcher.down()
+    print(f"terminated {n} nodes of cluster {cfg.cluster_name}")
+
+
 def cmd_serve(args):
     """serve deploy/status/delete/shutdown (reference: serve CLI in
     python/ray/serve/scripts.py over the REST schema)."""
@@ -328,6 +359,18 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="run the perf microbenchmark")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config_file")
+    sp.add_argument("--no-monitor", action="store_true",
+                    help="bootstrap min_workers only; no autoscaling loop")
+    sp.add_argument("--no-block", action="store_true",
+                    help="return immediately after bootstrap")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config_file")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("serve", help="manage Serve deployments")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)

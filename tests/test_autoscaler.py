@@ -155,3 +155,64 @@ def test_autoscaler_end_to_end_scales_up_for_queued_actor():
             monitor.stop()
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+# ---------------------------------------------------------- launcher (up)
+
+def test_cluster_launcher_yaml_up_down(tmp_path):
+    """`ray_tpu up` path: YAML -> ClusterConfig -> min_workers bootstrap
+    -> monitor-driven demand scaling -> down terminates everything
+    (reference: autoscaler/_private/commands.py create_or_update /
+    teardown_cluster)."""
+    import yaml as _yaml
+
+    from ray_tpu.autoscaler.launcher import ClusterConfig, ClusterLauncher
+    from ray_tpu.autoscaler.node_provider import MockNodeProvider
+
+    cfg_file = tmp_path / "cluster.yaml"
+    cfg_file.write_text(_yaml.safe_dump({
+        "cluster_name": "t",
+        "max_workers": 6,
+        "idle_timeout_s": 9999,   # no idle reaping during the test
+        "provider": {"type": "mock"},
+        "available_node_types": {
+            "cpu_node": {"resources": {"CPU": 4}, "min_workers": 2,
+                         "max_workers": 6},
+        },
+    }))
+    cfg = ClusterConfig.from_file(str(cfg_file))
+    assert cfg.node_types[0].min_workers == 2
+
+    demands = []
+    launcher = ClusterLauncher(
+        cfg, provider=MockNodeProvider(),
+        load_source=lambda: {"nodes": [], "pending_tasks": list(demands),
+                             "pending_actors": [],
+                             "pending_pg_bundles": []})
+    launched = launcher.up(start_monitor=True)
+    assert launched == {"cpu_node": 2}
+    assert len(launcher.provider.non_terminated_nodes()) == 2
+
+    # Demand beyond the floor: monitor must scale up.
+    demands.extend([{"CPU": 4}] * 4)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+            len(launcher.provider.non_terminated_nodes()) < 3:
+        time.sleep(0.2)
+    assert len(launcher.provider.non_terminated_nodes()) >= 3
+
+    n = launcher.down()
+    assert n >= 3
+    assert launcher.provider.non_terminated_nodes() == []
+
+
+def test_cluster_config_validation(tmp_path):
+    from ray_tpu.autoscaler.launcher import ClusterConfig
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="missing 'provider'"):
+        ClusterConfig.from_dict({"cluster_name": "x",
+                                 "available_node_types": {}})
+    with _pytest.raises(ValueError, match="unknown keys"):
+        ClusterConfig.from_dict({
+            "cluster_name": "x", "provider": {"type": "mock"},
+            "available_node_types": {"a": {"resource": {}}}})
