@@ -10,7 +10,8 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu import exceptions  # noqa: F401
-from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.object_ref import (ObjectRef,  # noqa: F401
+                                         ObjectRefGenerator)
 from ray_tpu._private.worker import global_worker
 from ray_tpu.actor import ActorClass, ActorHandle, exit_actor  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction

@@ -1003,8 +1003,14 @@ class CoreWorker:
         fid = self.export_function(func)
         task_id = task_id_generator.next()
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
+        # num_returns="dynamic" (reference: generator tasks,
+        # _raylet.pyx dynamic returns): the caller pre-owns only return 0
+        # — an ObjectRefGenerator listing per-yield refs the executor
+        # creates at indices 1..n; ownership of those registers when the
+        # reply arrives (_store_task_returns).
+        n_pre = 1 if num_returns == "dynamic" else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+                      for i in range(n_pre)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
         spec = {
             "task_id": task_id.hex(),
@@ -1434,7 +1440,21 @@ class CoreWorker:
     def _store_task_returns(self, reply: dict, return_ids):
         # Fully synchronous on purpose: the batch-reply path runs it from a
         # future done-callback, where no task exists to await anything.
-        for (oid_hex, kind, data), oid in zip(reply["returns"], return_ids):
+        entries = reply["returns"]
+        # Dynamic-return extras (generator tasks): entries beyond the
+        # pre-registered ids are per-yield objects the executor created;
+        # the caller becomes their owner NOW, before the generator ref
+        # (entry 0) is readable, so a get() of a yielded ref can never
+        # observe an unowned id.  (No lineage entry: reconstruction of a
+        # dynamic yield would re-run the whole generator — documented gap
+        # vs the reference's lineage for dynamic returns.)
+        for oid_hex, kind, data in entries[len(return_ids):]:
+            self.owned.add(oid_hex)
+            if kind == "inline":
+                self._store_local(oid_hex, "val", data)
+            else:
+                self._store_local(oid_hex, "plasma", None)
+        for (oid_hex, kind, data), oid in zip(entries, return_ids):
             if oid_hex not in self.owned:
                 continue  # freed while the task (or a reconstruction) ran
             if kind == "inline":
@@ -1514,8 +1534,9 @@ class CoreWorker:
                           *, num_returns=1) -> List[ObjectRef]:
         task_id = task_id_generator.next()
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
+        n_pre = 1 if num_returns == "dynamic" else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+                      for i in range(n_pre)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
         for oid in return_ids:
             self.owned.add(oid.hex())

@@ -51,6 +51,27 @@ class observe_pickled_refs:
         return False
 
 
+class ObjectRefGenerator:
+    """Result of getting a ``num_returns="dynamic"`` task's ref: the
+    ordered refs of everything the task yielded (reference:
+    ObjectRefGenerator / DynamicObjectRefGenerator in _raylet.pyx)."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
 class ObjectRef:
     __slots__ = ("id", "owner_address", "__weakref__")
 

@@ -256,6 +256,8 @@ class TaskExecutor:
 
     async def _pack_returns(self, spec: dict, result) -> dict:
         num_returns = spec["num_returns"]
+        if num_returns == "dynamic":
+            return await self._pack_dynamic_returns(spec, result)
         if num_returns == 1:
             results = [result]
         else:
@@ -273,6 +275,32 @@ class TaskExecutor:
             returns.append(
                 await self.core.store_return_value_async(oid, ser))
         return {"ok": True, "returns": returns}
+
+    async def _pack_dynamic_returns(self, spec: dict, result) -> dict:
+        """Generator task (num_returns="dynamic", reference: dynamic
+        returns in _raylet.pyx): store each yielded value as its own
+        object at return indices 1..n, then store an ObjectRefGenerator
+        listing their refs as return 0.  The reply carries every entry;
+        the caller registers ownership of the extras on receipt."""
+        from ray_tpu._private.ids import TaskID
+        from ray_tpu._private.object_ref import (ObjectRef,
+                                                 ObjectRefGenerator)
+        task_id = TaskID(
+            bytes.fromhex(spec.get("call_id") or spec["task_id"]))
+        owner = spec.get("owner_address", "")
+        entries, refs = [], []
+        i = 0
+        for value in result:   # raises TypeError for non-iterables: apt
+            i += 1
+            oid = ObjectID.for_task_return(task_id, i)
+            ser = self.core.ser.serialize(value)
+            entries.append(
+                await self.core.store_return_value_async(oid, ser))
+            refs.append(ObjectRef(oid, owner))
+        gen_oid = ObjectID.for_task_return(task_id, 0)
+        ser = self.core.ser.serialize(ObjectRefGenerator(refs))
+        entry0 = await self.core.store_return_value_async(gen_oid, ser)
+        return {"ok": True, "returns": [entry0] + entries}
 
     # -- actors --
 

@@ -43,7 +43,7 @@ class ActorMethod:
         refs = core.submit_actor_task(
             self._handle._actor_id_hex, self._method_name, args, kwargs,
             num_returns=self._num_returns)
-        if self._num_returns == 1:
+        if self._num_returns == 1 or self._num_returns == "dynamic":
             return refs[0]
         return refs
 

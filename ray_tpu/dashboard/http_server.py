@@ -112,6 +112,14 @@ class DashboardHttpServer:
                 await self._respond(writer, 200, json.dumps(
                     {"ok": False, "error": repr(e)}).encode())
             return
+        if path == "/api/serve":
+            # Controller-published status from GCS KV (see
+            # ServeController._publish_status).
+            raw = g.kv.get("serve", {}).get(b"status")
+            await self._respond(
+                writer, 200,
+                raw if raw else b'{"deployments": {}}')
+            return
         data = None
         if path == "/api/node_stats":
             data = g.node_stats

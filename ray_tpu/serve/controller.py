@@ -220,9 +220,35 @@ class ServeController:
             try:
                 await self._reconcile_once()
                 await self._autoscale()
+                await self._publish_status()
             except Exception:
                 logger.exception("serve reconcile failed")
             await asyncio.sleep(RECONCILE_PERIOD_S)
+
+    async def _publish_status(self):
+        """Push app status into GCS KV so the dashboard (which lives in
+        the GCS process, not a worker) can serve /api/serve without a
+        cluster client (reference: dashboard/modules/serve/ reads the
+        controller through ray calls; here KV is the decoupling).  Uses
+        the async GCS channel directly — this coroutine runs ON the core
+        IO loop, where the blocking kv_put wrapper would deadlock."""
+        import json as _json
+        import time as _time
+
+        from ray_tpu._private.worker import get_core
+        status = {
+            name: {
+                "target": self.targets.get(name, 0),
+                "running": len(self.replicas.get(name, [])),
+                "route_prefix": spec.route_prefix,
+            }
+            for name, spec in self.deployments.items()
+        }
+        await get_core().gcs.request({
+            "type": "kv_put", "ns": "serve", "key": b"status",
+            "value": _json.dumps({"deployments": status,
+                                  "updated_at": _time.time()}).encode(),
+            "overwrite": True})
 
     async def _reconcile_once(self):
         from ray_tpu._private.worker import get_core

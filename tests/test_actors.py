@@ -182,3 +182,17 @@ def test_actor_handle_passing(ray_start):
 
     assert ray_tpu.get(bump.remote(c)) == 1
     assert ray_tpu.get(c.get.remote()) == 1
+
+
+def test_actor_dynamic_num_returns(ray_start):
+    """Actor methods support num_returns="dynamic" like normal tasks."""
+    @ray_tpu.remote
+    class Gen:
+        def chunks(self, n):
+            for i in range(n):
+                yield [i] * 2
+
+    a = Gen.remote()
+    gen = ray_tpu.get(a.chunks.options(num_returns="dynamic").remote(3))
+    assert len(gen) == 3
+    assert ray_tpu.get(list(gen)) == [[0, 0], [1, 1], [2, 2]]

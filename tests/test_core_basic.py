@@ -132,3 +132,24 @@ def test_jax_array_roundtrip(ray_start):
     x = jnp.arange(32, dtype=jnp.float32)
     out = ray_tpu.get(ray_tpu.put(x))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_dynamic_num_returns_generator_task(ray_start):
+    """num_returns="dynamic" (reference: generator tasks): the task
+    yields a data-dependent number of values; get(ref) returns an
+    ObjectRefGenerator of per-yield refs."""
+    @ray_tpu.remote(num_returns="dynamic")
+    def splat(n):
+        for i in range(n):
+            yield i * i
+
+    gen = ray_tpu.get(splat.remote(5))
+    from ray_tpu import ObjectRefGenerator
+    assert isinstance(gen, ObjectRefGenerator)
+    assert len(gen) == 5
+    assert ray_tpu.get(list(gen)) == [0, 1, 4, 9, 16]
+    # Works with zero yields too.
+    assert len(ray_tpu.get(splat.remote(0))) == 0
+    # Refs remain gettable individually (ownership registered).
+    g2 = ray_tpu.get(splat.remote(3))
+    assert ray_tpu.get(g2[2]) == 4

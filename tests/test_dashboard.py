@@ -138,3 +138,28 @@ def test_profile_endpoint_captures_busy_worker(dash_cluster):
     joined = json.dumps(prof["stacks"])
     assert "burn_summing" in joined, joined[:500]
     ray_tpu.get(ref)
+
+
+def test_dashboard_serve_status(dash_cluster):
+    """/api/serve: controller publishes status into GCS KV each
+    reconcile; the dashboard serves it without a cluster client."""
+    from ray_tpu import serve
+    base = dash_cluster.get("dashboard_address")
+    code, body = _get(base, "/api/serve")
+    assert code == 200 and json.loads(body)["deployments"] == {}
+
+    @serve.deployment(num_replicas=1, ray_actor_options={"num_cpus": 0.1})
+    def echo(x):
+        return x
+
+    serve.run(echo.bind())
+    deadline = time.monotonic() + 30
+    deps = {}
+    while time.monotonic() < deadline:
+        code, body = _get(base, "/api/serve")
+        deps = json.loads(body).get("deployments", {})
+        if deps.get("echo", {}).get("running") == 1:
+            break
+        time.sleep(0.5)
+    assert deps.get("echo", {}).get("running") == 1, deps
+    serve.shutdown()
