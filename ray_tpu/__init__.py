@@ -14,6 +14,7 @@ from ray_tpu._private.object_ref import (ObjectRef,  # noqa: F401
                                          ObjectRefGenerator)
 from ray_tpu._private.worker import global_worker
 from ray_tpu.actor import ActorClass, ActorHandle, exit_actor  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"

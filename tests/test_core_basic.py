@@ -153,3 +153,30 @@ def test_dynamic_num_returns_generator_task(ray_start):
     # Refs remain gettable individually (ownership registered).
     g2 = ray_tpu.get(splat.remote(3))
     assert ray_tpu.get(g2[2]) == 4
+
+
+def test_get_runtime_context(ray_start):
+    """ray.get_runtime_context() analog: driver vs task vs actor views."""
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.worker_mode == "driver"
+    assert ctx.get_task_id() is None and ctx.get_actor_id() is None
+    assert len(ctx.get_node_id()) > 8
+
+    @ray_tpu.remote
+    def probe():
+        c = ray_tpu.get_runtime_context()
+        return c.get()
+
+    d = ray_tpu.get(probe.remote())
+    assert d["worker_mode"] == "worker"
+    assert d["task_id"] and d["actor_id"] is None
+    assert d["node_id"] == ctx.get_node_id()   # single-node cluster
+
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            return ray_tpu.get_runtime_context().get()
+
+    a = A.remote()
+    d = ray_tpu.get(a.who.remote())
+    assert d["actor_id"]
