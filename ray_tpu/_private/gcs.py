@@ -442,10 +442,19 @@ class GcsServer:
     async def _h_get_nodes(self, conn, msg):
         return [n.public() for n in self.nodes.values()]
 
+    async def _h_set_resource_request(self, conn, msg):
+        """Programmatic autoscaler demand (reference:
+        autoscaler/sdk.py request_resources -> GCS resource_request):
+        replaces the whole request set; bundles are held as standing
+        demand until the next call clears or changes them."""
+        self._resource_request = [dict(b) for b in msg.get("bundles", [])]
+        return True
+
     async def _h_get_load_metrics(self, conn, msg):
         """Cluster load view for the autoscaler (reference:
         autoscaler/_private/load_metrics.py fed by ray_syncer gossip)."""
         pending_tasks: List[Dict[str, float]] = []
+        pending_tasks.extend(getattr(self, "_resource_request", []))
         for node in self.nodes.values():
             if node.alive:
                 pending_tasks.extend(node.pending_demand)

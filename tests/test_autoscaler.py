@@ -216,3 +216,39 @@ def test_cluster_config_validation(tmp_path):
         ClusterConfig.from_dict({
             "cluster_name": "x", "provider": {"type": "mock"},
             "available_node_types": {"a": {"resource": {}}}})
+
+
+def test_request_resources_drives_scale_up():
+    """autoscaler.sdk.request_resources: standing demand (no actual
+    tasks) must scale the cluster up, and a cleared request stops
+    fueling it (reference: autoscaler/sdk.py -> load_metrics
+    resource_requests)."""
+    from ray_tpu.autoscaler.sdk import request_resources
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    monitor = None
+    try:
+        ray_tpu.init(address=cluster.address,
+                     _worker_env={"JAX_PLATFORMS": "cpu"})
+        provider = MockNodeProvider()
+        cfg = AutoscalerConfig(
+            node_types=[NodeTypeConfig("cpu4", {"CPU": 4.0})],
+            idle_timeout_s=3600)
+        monitor = Monitor(provider, cfg, update_interval_s=0.3).start()
+
+        request_resources(num_cpus=8)   # no tasks exist at all
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                len(provider.non_terminated_nodes()) < 2:
+            time.sleep(0.2)
+        assert len(provider.non_terminated_nodes()) >= 2
+
+        request_resources()             # clear
+        n_after_clear = len(provider.non_terminated_nodes())
+        time.sleep(1.5)
+        assert len(provider.non_terminated_nodes()) == n_after_clear
+    finally:
+        if monitor:
+            monitor.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
