@@ -13,7 +13,8 @@ from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.object_ref import (ObjectRef,  # noqa: F401
                                          ObjectRefGenerator)
 from ray_tpu._private.worker import global_worker
-from ray_tpu.actor import ActorClass, ActorHandle, exit_actor  # noqa: F401
+from ray_tpu.actor import (ActorClass, ActorHandle,  # noqa: F401
+                           exit_actor, method)
 from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 

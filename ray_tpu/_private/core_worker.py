@@ -1468,13 +1468,15 @@ class CoreWorker:
                                     resources=None, max_restarts=0,
                                     name=None, namespace="default",
                                     get_if_exists=False, detached=False,
-                                    max_concurrency=1, scheduling=None):
+                                    max_concurrency=1, scheduling=None,
+                                    concurrency_groups=None):
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         creation_spec = cloudpickle.dumps({
             "cls": cloudpickle.dumps(cls),
             "args": s_args,
             "kwargs": s_kwargs,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
             "name": name,
         })
         return {
@@ -1511,12 +1513,13 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, resources=None,
                      max_restarts=0, name=None, namespace="default",
                      get_if_exists=False, detached=False, max_concurrency=1,
-                     scheduling=None) -> str:
+                     concurrency_groups=None, scheduling=None) -> str:
         req, pinned_args = self._build_create_actor_request(
             cls, args, kwargs, resources=resources,
             max_restarts=max_restarts, name=name, namespace=namespace,
             get_if_exists=get_if_exists, detached=detached,
-            max_concurrency=max_concurrency, scheduling=scheduling)
+            max_concurrency=max_concurrency, scheduling=scheduling,
+            concurrency_groups=concurrency_groups)
         reply = self._run(self.gcs.request(req))
         self._pin_actor_creation(reply["actor_id"], pinned_args)
         return reply["actor_id"]
@@ -1531,7 +1534,8 @@ class CoreWorker:
         return st
 
     def submit_actor_task(self, actor_id_hex: str, method: str, args, kwargs,
-                          *, num_returns=1) -> List[ObjectRef]:
+                          *, num_returns=1,
+                          concurrency_group=None) -> List[ObjectRef]:
         task_id = task_id_generator.next()
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         n_pre = 1 if num_returns == "dynamic" else num_returns
@@ -1549,6 +1553,8 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": self.address,
         }
+        if concurrency_group is not None:
+            call["concurrency_group"] = concurrency_group
         from ray_tpu.util import tracing
         if tracing.enabled():
             call["trace"] = {"ctx": tracing.current_context()}

@@ -317,6 +317,14 @@ class TaskExecutor:
                 timeout=_rt_config().arg_resolution_timeout_s)
             self.max_concurrency = spec.get("max_concurrency", 1)
             self._sem = asyncio.Semaphore(self.max_concurrency)
+            # Named concurrency groups (reference:
+            # core_worker/transport/concurrency_group_manager.h + the
+            # fiber-per-group execution of async actors): each group gets
+            # its own semaphore so e.g. "io" calls can't starve
+            # "compute" calls of slots.
+            self._group_sems = {
+                g: asyncio.Semaphore(int(n))
+                for g, n in (spec.get("concurrency_groups") or {}).items()}
             self.actor_id = msg["actor_id"]
             loop = asyncio.get_running_loop()
             self.actor_instance = await self.core.exec_pool.run(
@@ -382,8 +390,21 @@ class TaskExecutor:
                 parent = tuple(tr["ctx"]) if tr.get("ctx") else None
                 name = f"actor:{msg['method']}"
             if inspect.iscoroutinefunction(method):
-                async with self._sem:
-                    self._advance(order, seq)
+                group = msg.get("concurrency_group") or getattr(
+                    method, "_rt_concurrency_group", None)
+                sem = self._group_sems.get(group, self._sem) \
+                    if getattr(self, "_group_sems", None) else self._sem
+                if group and (not getattr(self, "_group_sems", None)
+                              or group not in self._group_sems):
+                    raise ValueError(
+                        f"unknown concurrency group {group!r}; declared: "
+                        f"{sorted(getattr(self, '_group_sems', {}))}")
+                # Advance the order cursor BEFORE acquiring the slot:
+                # a saturated group must not stall calls bound for other
+                # groups.  Same-group start order is still FIFO
+                # (asyncio.Semaphore wakes waiters in acquire order).
+                self._advance(order, seq)
+                async with sem:
                     if tr is not None:
                         with tracing.span(name, _remote_parent=parent):
                             result = await method(*args, **kwargs)

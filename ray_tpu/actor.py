@@ -28,27 +28,31 @@ _ACTOR_DEFAULTS = dict(
     scheduling_strategy=None,
     runtime_env=None,
     num_returns=1,
+    concurrency_groups=None,
 )
 
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group=None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         core = get_core()
         refs = core.submit_actor_task(
             self._handle._actor_id_hex, self._method_name, args, kwargs,
-            num_returns=self._num_returns)
+            num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
         if self._num_returns == 1 or self._num_returns == "dynamic":
             return refs[0]
         return refs
 
-    def options(self, num_returns: int = 1, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1, concurrency_group=None, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -122,6 +126,7 @@ class ActorClass:
             get_if_exists=opts["get_if_exists"],
             detached=opts["lifetime"] == "detached",
             max_concurrency=opts["max_concurrency"],
+            concurrency_groups=opts.get("concurrency_groups"),
             scheduling=_build_scheduling(opts),
         )
         # Detached/named actors outlive their handles by design; anonymous
@@ -135,3 +140,17 @@ def exit_actor():
     """Terminate the current actor from inside one of its methods
     (reference: ray.actor.exit_actor)."""
     raise SystemExit(0)
+
+
+def method(*, concurrency_group: str = None, num_returns=None):
+    """Per-method options decorator (reference: ``ray.method``): tag an
+    actor method with its concurrency group and/or return arity."""
+
+    def wrap(fn):
+        if concurrency_group is not None:
+            fn._rt_concurrency_group = concurrency_group
+        if num_returns is not None:
+            fn._rt_num_returns = num_returns
+        return fn
+
+    return wrap
