@@ -65,6 +65,7 @@ class Deployment:
             num_cpus=self.config.get("ray_actor_options", {}).get(
                 "num_cpus", 1.0),
             autoscaling=self.config.get("autoscaling_config"),
+            user_config=self.config.get("user_config"),
         )
 
 

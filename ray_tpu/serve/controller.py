@@ -37,18 +37,24 @@ class DeploymentSpec:
     num_cpus: float = 1.0
     autoscaling: Optional[Dict[str, Any]] = None  # min/max_replicas,
     #                                              target_queue_len
+    # Arbitrary config pushed to live replicas via reconfigure() without a
+    # restart (reference: deployment user_config + replica reconfigure).
+    user_config: Optional[Dict[str, Any]] = None
 
 
 class Replica:
     """Actor body hosting one deployment replica."""
 
-    def __init__(self, callable_blob: bytes, max_concurrent_queries: int = 8):
+    def __init__(self, callable_blob: bytes, max_concurrent_queries: int = 8,
+                 user_config: Optional[Dict[str, Any]] = None):
         import cloudpickle
         target, args, kwargs = cloudpickle.loads(callable_blob)
         if isinstance(target, type):
             self._fn = target(*args, **kwargs)
         else:
             self._fn = target
+        if user_config is not None:
+            self.reconfigure(user_config)
         self._outstanding = 0
         # Concurrency is bounded HERE, not by the actor's max_concurrency:
         # requests waiting on an actor-level semaphore would be invisible to
@@ -81,6 +87,18 @@ class Replica:
                 return result
         finally:
             self._outstanding -= 1
+
+    def reconfigure(self, user_config: Dict[str, Any]) -> bool:
+        """Apply a user_config update in place (reference: the replica
+        calls the user class's reconfigure(user_config) on deploy-time
+        config changes — no restart)."""
+        hook = getattr(self._fn, "reconfigure", None)
+        if hook is None:
+            raise ValueError(
+                "deployment has user_config but its class defines no "
+                "reconfigure(user_config) method")
+        hook(user_config)
+        return True
 
     def queue_len(self) -> int:
         return self._outstanding
@@ -149,6 +167,8 @@ class ServeController:
             old.max_concurrent_queries != spec.max_concurrent_queries or
             old.num_cpus != spec.num_cpus or
             old.resources != spec.resources)
+        config_changed = (old is not None and not code_changed
+                          and old.user_config != spec.user_config)
         self.deployments[spec.name] = spec
         self.targets[spec.name] = spec.num_replicas
         if spec.autoscaling:
@@ -161,6 +181,13 @@ class ServeController:
                 for r in self.replicas.get(spec.name, []):
                     await self._kill_replica(r)
                 self.replicas[spec.name] = []
+        elif config_changed:
+            # Lightweight path: push the new user_config into live
+            # replicas in place — no restart, in-flight requests unharmed.
+            async with self._reconcile_lock:
+                for r in self.replicas.get(spec.name, []):
+                    await asyncio.wait_for(
+                        r.reconfigure.remote(spec.user_config), timeout=30)
         await self._reconcile_once()
         return True
 
@@ -286,7 +313,8 @@ class ServeController:
                     # queue_len) instead of at the actor layer.
                     actor_id = await get_core().create_actor_async(
                         Replica,
-                        (spec.callable_blob, spec.max_concurrent_queries),
+                        (spec.callable_blob, spec.max_concurrent_queries,
+                         spec.user_config),
                         {},
                         resources=resources,
                         max_concurrency=4 * spec.max_concurrent_queries + 8,

@@ -338,3 +338,36 @@ def test_schema_validation_errors(tmp_path):
                  "    num_replicas: 3\n")
     s = ServeApplicationSchema.from_file(str(p))
     assert s.deployments[0].num_replicas == 3
+
+
+def test_user_config_reconfigure_without_restart(serve_cluster):
+    """user_config changes push reconfigure() into LIVE replicas (no
+    restart); reference: deployment user_config + replica reconfigure."""
+    import os
+
+    @serve.deployment(num_replicas=1, user_config={"factor": 2},
+                      ray_actor_options={"num_cpus": 0.1})
+    class Scaler:
+        def __init__(self):
+            self.factor = 1
+
+        def reconfigure(self, cfg):
+            self.factor = cfg["factor"]
+
+        def __call__(self, x):
+            return self.factor * x, os.getpid()
+
+    h = serve.run(Scaler.bind())
+    v, pid1 = ray_tpu.get(h.remote(10))
+    assert v == 20                       # init-time user_config applied
+
+    h = serve.run(Scaler.options(user_config={"factor": 7}).bind())
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        v, pid2 = ray_tpu.get(h.remote(10))
+        if v == 70:
+            break
+        time.sleep(0.3)
+    assert v == 70
+    assert pid2 == pid1, "replica restarted on a config-only change"
+    serve.delete("Scaler")
