@@ -693,6 +693,13 @@ class Dataset:
         ``key=None`` forms a single global group."""
         return GroupedData(self, key)
 
+    def aggregate(self, *aggs: "AggregateFn"):
+        """Whole-dataset aggregation (reference: ``Dataset.aggregate``):
+        one global group; returns the single result row (a dict keyed by
+        each AggregateFn's name)."""
+        [row] = GroupedData(self, None).aggregate(*aggs).take_all()
+        return row
+
     def _values(self, on: Optional[str]) -> List[float]:
         vals = []
         for r in self.iter_rows():

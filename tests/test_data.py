@@ -310,3 +310,10 @@ def test_iter_torch_batches_and_to_torch(ray_start):
     it_ds = ds.to_torch(label_column="y", batch_size=5)
     feats, label = next(iter(it_ds))
     assert set(feats) == {"x"} and label.shape[0] == 5
+
+
+def test_dataset_aggregate_global(ray_start):
+    ds = rd.from_items([{"v": float(i)} for i in range(10)]).repartition(3)
+    row = ds.aggregate(rd.Count(), rd.Mean("v"), rd.Max("v"))
+    assert row["count()"] == 10
+    assert abs(row["mean(v)"] - 4.5) < 1e-9 and row["max(v)"] == 9.0
