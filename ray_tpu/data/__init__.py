@@ -28,6 +28,7 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,  # noqa: A001 - mirrors reference API name
     range_tensor,
+    read_binary_files,
     read_csv,
     read_json,
     read_numpy,
@@ -42,7 +43,7 @@ __all__ = [
     "GroupedData", "Max", "Mean", "Min", "RandomAccessDataset", "Std", "Sum",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "range_tensor",
-    "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",
+    "read_binary_files", "read_csv", "read_json", "read_numpy", "read_parquet", "read_text",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -143,6 +143,22 @@ def read_text(paths, **_) -> Dataset:
     return _read_files(paths, _read_text_file, None)
 
 
+def _read_binary_file(path, include_paths):
+    with open(path, "rb") as f:
+        data = f.read()
+    return [{"path": path, "bytes": data} if include_paths
+            else {"bytes": data}]
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      **_) -> Dataset:
+    """One row per file with its raw bytes (reference:
+    ``ray.data.read_binary_files``)."""
+    files = _expand_paths(paths, None)
+    task = ray_tpu.remote(_read_binary_file)
+    return Dataset([task.remote(f, include_paths) for f in files])
+
+
 def from_arrow(tables, *, parallelism: int = 0) -> Dataset:
     """Dataset from pyarrow Table(s) (reference: ray.data.from_arrow).
 

@@ -317,3 +317,12 @@ def test_dataset_aggregate_global(ray_start):
     row = ds.aggregate(rd.Count(), rd.Mean("v"), rd.Max("v"))
     assert row["count()"] == 10
     assert abs(row["mean(v)"] - 4.5) < 1e-9 and row["max(v)"] == 9.0
+
+
+def test_read_binary_files(ray_start, tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"\x00\x01\x02")
+    (tmp_path / "b.bin").write_bytes(b"hello")
+    ds = rd.read_binary_files(str(tmp_path), include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert [r["bytes"] for r in rows] == [b"\x00\x01\x02", b"hello"]
+    assert rows[0]["path"].endswith("a.bin")
