@@ -149,6 +149,23 @@ def apply_rope(x, cos, sin):
         axis=-1).astype(x.dtype)
 
 
+def _dense_causal_attention_gqa(q, k, v, rep: int):
+    """Head-major grouped-query dense attention: q [B, N, S, H] with
+    N = G*rep query heads sharing k/v [B, G, S, H].  Scores/output keep
+    the (group, rep) split so K/V never replicate in memory."""
+    import numpy as _np
+    B, N, S, H = q.shape
+    G = N // rep
+    qg = q.reshape(B, G, rep, S, H)
+    scores = jnp.einsum("bgrqh,bgkh->bgrqk", qg, k) / _np.sqrt(H)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None, None],
+                       scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bgrqk,bgkh->bgrqh", probs, v)
+    return o.reshape(B, N, S, H)
+
+
 def _block(cfg: LlamaConfig, rules: Optional[LogicalAxisRules],
            attn_fn: Callable, cos, sin, x, p):
     lc = (lambda a, ax: with_logical_constraint(a, rules, ax)) if rules \
@@ -164,13 +181,20 @@ def _block(cfg: LlamaConfig, rules: Optional[LogicalAxisRules],
     k, v = kv[:, 0], kv[:, 1]
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if rep > 1:   # GQA: share each kv head across `rep` query heads
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    q = lc(q, ("batch", "heads", "seq", "kv"))
-    k = lc(k, ("batch", "heads", "seq", "kv"))
-    v = lc(v, ("batch", "heads", "seq", "kv"))
-    o = _checkpoint_name(attn_fn(q, k, v), "attn_out")
+    if rep > 1 and getattr(attn_fn, "_gqa_native", False):
+        # Grouped dense path: fold the share-group dim into the einsum —
+        # K/V stay at kv_heads width (no jnp.repeat materializing rep
+        # copies of the KV tensors in HBM).
+        o = _checkpoint_name(
+            _dense_causal_attention_gqa(q, k, v, rep), "attn_out")
+    else:
+        if rep > 1:   # flash kernel expects equal head counts
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        q = lc(q, ("batch", "heads", "seq", "kv"))
+        k = lc(k, ("batch", "heads", "seq", "kv"))
+        v = lc(v, ("batch", "heads", "seq", "kv"))
+        o = _checkpoint_name(attn_fn(q, k, v), "attn_out")
     x = x + jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
     x = lc(x, ("batch", "seq", "embed"))
 
@@ -198,7 +222,10 @@ def llama_forward(params: Dict[str, Any], tokens: jax.Array,
                                    "bnsh")
     else:
         from ray_tpu.models.gpt import _dense_causal_attention_bnsh
-        attn_fn = _dense_causal_attention_bnsh
+
+        def attn_fn(q, k, v):
+            return _dense_causal_attention_bnsh(q, k, v)
+        attn_fn._gqa_native = True
 
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
     x = params["wte"].astype(dt)[tokens]

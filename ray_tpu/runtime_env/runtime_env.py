@@ -249,15 +249,24 @@ def _materialize_pip(reqs, extract, kv_get, cache_root: str) -> str:
             os.replace(tmp, path)
         return path
 
+    tmp = f"{dest}.tmp.{os.getpid()}"
     local_reqs = []
     for r in reqs:
         if r.startswith("pkg:"):
-            local_reqs.append(extract(r))   # shipped source dir
+            # Private copy: --no-build-isolation builds IN-TREE, so two
+            # concurrent installers sharing the content-addressed source
+            # dir would collide in its build/ directory (Errno 17 on
+            # dist-info).  Each installer builds its own copy.
+            src = extract(r)
+            copy = os.path.join(f"{tmp}.src", os.path.basename(src))
+            shutil.copytree(src, copy,
+                            ignore=shutil.ignore_patterns("build",
+                                                          "*.egg-info"))
+            local_reqs.append(copy)
         elif r.startswith("pkgfile:"):
             local_reqs.append(fetch_file(r))
         else:
             local_reqs.append(r)
-    tmp = f"{dest}.tmp.{os.getpid()}"
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     try:
         proc = subprocess.run(
@@ -278,3 +287,4 @@ def _materialize_pip(reqs, extract, kv_get, cache_root: str) -> str:
         return dest
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(f"{tmp}.src", ignore_errors=True)

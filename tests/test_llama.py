@@ -135,3 +135,21 @@ def test_llama_sharded_matches_single_device():
     l_single = run(None)
     assert abs(l_single - run(MeshSpec(dp=8))) < 1e-4
     assert abs(l_single - run(MeshSpec(tp=2, fsdp=4))) < 1e-4
+
+
+def test_gqa_grouped_matches_repeat_path():
+    """The repeat-free grouped dense attention must equal the
+    materialized-repeat formulation exactly."""
+    from ray_tpu.models.gpt import _dense_causal_attention_bnsh
+    from ray_tpu.models.llama import _dense_causal_attention_gqa
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    B, G, rep, S, H = 2, 2, 3, 16, 8
+    q = jax.random.normal(kq, (B, G * rep, S, H))
+    k = jax.random.normal(kk, (B, G, S, H))
+    v = jax.random.normal(kv, (B, G, S, H))
+    grouped = _dense_causal_attention_gqa(q, k, v, rep)
+    repeated = _dense_causal_attention_bnsh(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1))
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(repeated),
+                               atol=1e-5)
