@@ -1,4 +1,8 @@
 from ray_tpu.train.jax.config import JaxConfig
 from ray_tpu.train.jax.jax_trainer import JaxTrainer
+from ray_tpu.train.jax.orbax_checkpoint import (JaxCheckpoint,
+                                                restore_sharded,
+                                                save_sharded)
 
-__all__ = ["JaxConfig", "JaxTrainer"]
+__all__ = ["JaxCheckpoint", "JaxConfig", "JaxTrainer",
+           "restore_sharded", "save_sharded"]

@@ -1,0 +1,83 @@
+"""Orbax-backed sharded checkpointing for JAX train states.
+
+Design analog: reference framework checkpoint flavors
+(``train/torch/torch_checkpoint.py`` TorchCheckpoint etc.) — here the
+TPU-idiomatic one: Orbax writes each array's shards from the devices
+that hold them (every host saves only its addressable shards, the
+standard multi-controller pattern), and restore places shards directly
+onto the target mesh without materializing full arrays on one host.
+Wraps into the AIR ``Checkpoint`` envelope so Train/Tune plumbing
+(session.report, resume_from_checkpoint, Result.checkpoint) is
+unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+def save_sharded(path: str, tree: Any) -> str:
+    """Write a (possibly sharded) pytree of jax.Arrays with Orbax.
+
+    Under a Mesh each process writes only its addressable shards;
+    single-process saves degrade to a normal array dump."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore_sharded(path: str, target: Optional[Any] = None) -> Any:
+    """Restore an Orbax checkpoint.
+
+    ``target``: a pytree of abstract shapes/arrays carrying shardings
+    (e.g. the freshly-initialized, mesh-sharded params) — shards load
+    straight onto their devices.  Without it, arrays restore replicated
+    on the default device."""
+    import jax
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            target)
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)
+
+
+class JaxCheckpoint(Checkpoint):
+    """AIR Checkpoint flavor holding an Orbax directory (reference:
+    framework Checkpoint subclasses).  ``from_sharded_state`` saves the
+    live (sharded) train state; ``load_state(target=...)`` restores it
+    onto a mesh."""
+
+    @classmethod
+    def from_sharded_state(cls, tree: Any, *, path: Optional[str] = None,
+                           **extra) -> "JaxCheckpoint":
+        import json
+        import tempfile
+        path = path or tempfile.mkdtemp(prefix="rt-orbax-")
+        save_sharded(os.path.join(path, "state"), tree)
+        if extra:
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(extra, f, default=str)
+        return cls.from_directory(path)
+
+    def meta(self) -> dict:
+        import json
+        p = os.path.join(self.to_directory(), "meta.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def load_state(self, target: Optional[Any] = None) -> Any:
+        root = self.to_directory()
+        return restore_sharded(os.path.join(root, "state"), target)

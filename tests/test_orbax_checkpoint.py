@@ -1,0 +1,53 @@
+"""Orbax sharded checkpointing (TPU-idiomatic Checkpoint flavor).
+
+Reference shape: framework checkpoint subclasses (torch_checkpoint.py);
+here the save/restore round-trips SHARDED arrays on the virtual
+8-device mesh — each leaf keeps its sharding through restore.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.parallel import LogicalAxisRules, MeshSpec
+from ray_tpu.parallel.sharding import shard_params
+from ray_tpu.train.jax import JaxCheckpoint, restore_sharded, save_sharded
+
+
+def test_sharded_save_restore_roundtrip(tmp_path):
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    mesh = spec.build()
+    rules = LogicalAxisRules.for_transformer(spec)
+    axes = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    with jax.sharding.set_mesh(mesh):
+        tree = {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                "b": jnp.ones((8,), jnp.float32)}
+        tree = shard_params(tree, mesh, rules, axes)
+        path = str(tmp_path / "ck")
+        save_sharded(path, tree)
+
+        # Restore onto the SAME shardings: shards land on their devices.
+        restored = restore_sharded(path, target=tree)
+        assert restored["w"].sharding == tree["w"].sharding
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(restored["b"]),
+                                      np.asarray(tree["b"]))
+
+    # Restore without a target (replicated) still round-trips values.
+    flat = restore_sharded(path)
+    np.testing.assert_array_equal(np.asarray(flat["w"]),
+                                  np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+def test_jax_checkpoint_envelope(tmp_path):
+    spec = MeshSpec(dp=8)
+    mesh = spec.build()
+    with jax.sharding.set_mesh(mesh):
+        tree = {"p": jnp.full((16, 4), 3.0)}
+        ckpt = JaxCheckpoint.from_sharded_state(
+            tree, path=str(tmp_path / "env"), step=7)
+        assert ckpt.meta()["step"] == 7
+        out = ckpt.load_state()
+        np.testing.assert_array_equal(np.asarray(out["p"]),
+                                      np.asarray(tree["p"]))
