@@ -6,6 +6,7 @@ rollouts on host-CPU actors, one jitted learner program on the device.
 
 from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CPolicy
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOPolicy
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy
 from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.td3 import TD3, TD3Config, TD3Policy
@@ -33,7 +34,7 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = [
-    "A2C", "A2CConfig", "A2CPolicy",
+    "A2C", "A2CConfig", "A2CPolicy", "APPO", "APPOConfig", "APPOPolicy",
     "Algorithm", "AlgorithmConfig", "AttentionPPOPolicy", "BC", "BCConfig",
     "BCPolicy", "ModelCatalog",
     "CartPoleVectorEnv", "CQL", "CQLConfig", "DatasetReader",

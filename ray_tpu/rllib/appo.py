@@ -1,0 +1,94 @@
+"""APPO: asynchronous PPO (IMPALA machinery + clipped surrogate).
+
+Design analog: reference ``rllib/algorithms/appo/appo.py`` — IMPALA's
+async actor/learner pipeline, but the learner applies PPO's clipped
+surrogate over V-trace-corrected advantages instead of the plain
+policy-gradient loss (clipping bounds the update against the stale
+behavior policy; V-trace corrects the value targets).  All the
+machinery — async fragment harvesting, host->device loader thread,
+broadcast interval — is inherited from ``rllib/impala.py``; only the
+jitted loss differs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy, vtrace
+from ray_tpu.rllib.policy import Categorical, ac_forward
+from ray_tpu.rllib.sample_batch import (ACTIONS, ACTION_LOGP, DONES, OBS,
+                                        REWARDS)
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self._config.update({
+            "policy": "appo",
+            "clip_param": 0.2,
+            "lr": 5e-4,
+        })
+        self.algo_class = APPO
+
+
+class APPOPolicy(ImpalaPolicy):
+    """IMPALA policy with the update swapped for a clipped surrogate."""
+
+    def __init__(self, obs_dim: int, action_space, config: Dict[str, Any],
+                 seed: int = 0):
+        super().__init__(obs_dim, action_space, config, seed=seed)
+        gamma = config.get("gamma", 0.99)
+        rho_clip = config.get("vtrace_rho_clip", 1.0)
+        c_clip = config.get("vtrace_c_clip", 1.0)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+        clip = config.get("clip_param", 0.2)
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            B, T = batch[REWARDS].shape
+            flat_obs = batch[OBS].reshape((B * T,) + batch[OBS].shape[2:])
+
+            def loss_fn(p):
+                pi, v = ac_forward(p, flat_obs)
+                logp = Categorical.logp(
+                    pi, batch[ACTIONS].reshape((B * T,)))
+                entropy = Categorical.entropy(pi)
+                v = v.reshape((B, T))
+                logp_bt = logp.reshape((B, T))
+                _, boot_v = ac_forward(p, batch["bootstrap_obs"])
+                vs, pg_adv = vtrace(
+                    batch[ACTION_LOGP], logp_bt, batch[REWARDS],
+                    batch[DONES], v, boot_v, gamma, rho_clip, c_clip)
+                # PPO clip against the BEHAVIOR policy's logp: the async
+                # gap is exactly the ratio being clipped (reference
+                # appo_torch_policy.py surrogate over vtrace advantages).
+                ratio = jnp.exp(logp_bt - batch[ACTION_LOGP])
+                surr = jnp.minimum(
+                    ratio * pg_adv,
+                    jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+                pg_loss = -jnp.mean(surr)
+                vf_loss = 0.5 * jnp.mean((vs - v) ** 2)
+                ent = jnp.mean(entropy)
+                total = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+                return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                               "entropy": ent, "total_loss": total}
+
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            import optax as _ox
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = _ox.apply_updates(params, updates)
+            return params, opt_state, stats
+        self._update = _update
+
+
+class APPO(Impala):
+    def setup(self, config: Dict[str, Any]) -> None:
+        config = dict(config)
+        config.setdefault("policy", "appo")
+        super().setup(config)

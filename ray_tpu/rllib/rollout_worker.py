@@ -33,6 +33,9 @@ def _resolve_policy_class(name: str):
     if name == "impala":
         from ray_tpu.rllib.impala import ImpalaPolicy
         return ImpalaPolicy
+    if name == "appo":
+        from ray_tpu.rllib.appo import APPOPolicy
+        return APPOPolicy
     if name == "sac":
         from ray_tpu.rllib.sac import SACPolicy
         return SACPolicy
