@@ -550,6 +550,32 @@ class Dataset:
                                fn_constructor_kwargs=fn_constructor_kwargs)
 
     # -- reshaping --------------------------------------------------------
+    # -- column ops (reference: Dataset.select_columns et al.) -----------
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        cols = list(cols)
+        return self.map_batches(
+            lambda b: {c: b[c] for c in cols}, batch_format="numpy")
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {c: v for c, v in b.items() if c not in drop},
+            batch_format="numpy")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        """``fn(batch_dict) -> column array`` (reference Dataset.add_column
+        takes the pandas batch; here the numpy dict batch)."""
+        def _add(b):
+            out = dict(b)
+            out[name] = np.asarray(fn(b))
+            return out
+        return self.map_batches(_add, batch_format="numpy")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(c, c): v for c, v in b.items()},
+            batch_format="numpy")
+
     def _rechunk(self, sizes: List[int]) -> "Dataset":
         """Re-slice into blocks of exactly the given row counts via a
         slice/merge task DAG (no driver materialization)."""

@@ -326,3 +326,14 @@ def test_read_binary_files(ray_start, tmp_path):
     rows = sorted(ds.take_all(), key=lambda r: r["path"])
     assert [r["bytes"] for r in rows] == [b"\x00\x01\x02", b"hello"]
     assert rows[0]["path"].endswith("a.bin")
+
+
+def test_column_operations(ray_start):
+    rows = [{"a": i, "b": 2 * i, "c": 3 * i} for i in range(8)]
+    ds = rd.from_items(rows).repartition(2)
+    assert set(ds.select_columns(["a", "c"]).take(1)[0]) == {"a", "c"}
+    assert set(ds.drop_columns(["b"]).take(1)[0]) == {"a", "c"}
+    with_sum = ds.add_column("s", lambda b: b["a"] + b["b"])
+    assert [r["s"] for r in with_sum.take(3)] == [0, 3, 6]
+    ren = ds.rename_columns({"a": "x"})
+    assert set(ren.take(1)[0]) == {"x", "b", "c"}
