@@ -7,6 +7,7 @@ rollouts on host-CPU actors, one jitted learner program on the device.
 from ray_tpu.rllib.a2c import A2C, A2CConfig, A2CPolicy
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOPolicy
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, DDPGPolicy
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNPolicy
 from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.td3 import TD3, TD3Config, TD3Policy
@@ -38,7 +39,8 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "AttentionPPOPolicy", "BC", "BCConfig",
     "BCPolicy", "ModelCatalog",
     "CartPoleVectorEnv", "CQL", "CQLConfig", "DatasetReader",
-    "DatasetWriter", "DQN", "DQNConfig", "DQNPolicy", "ES", "ESConfig",
+    "DatasetWriter", "DDPG", "DDPGConfig", "DDPGPolicy",
+    "DQN", "DQNConfig", "DQNPolicy", "ES", "ESConfig",
     "Env", "Impala",
     "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",
     "MARWIL", "MARWILConfig", "MARWILPolicy",

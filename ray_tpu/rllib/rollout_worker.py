@@ -57,6 +57,9 @@ def _resolve_policy_class(name: str):
     if name == "td3":
         from ray_tpu.rllib.td3 import TD3Policy
         return TD3Policy
+    if name == "ddpg":
+        from ray_tpu.rllib.ddpg import DDPGPolicy
+        return DDPGPolicy
     raise ValueError(f"unknown policy {name!r}")
 
 

@@ -261,3 +261,30 @@ assert best >= 140, f"best={best}"
 print("APPO_LEARNED", best)
 """)
     assert "APPO_LEARNED" in out
+
+
+@pytest.mark.slow
+def test_ddpg_learns_pendulum():
+    """DDPG (TD3 minus twin-min exploitation fixes) still clears a looser
+    Pendulum bar (random ~-1200)."""
+    out = _run_learning_script("""
+from ray_tpu.rllib import DDPGConfig
+algo = (DDPGConfig().environment("Pendulum-v1")
+        .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                  rollout_fragment_length=8)
+        .training(learning_starts=1000, train_batch_size=256,
+                  num_train_iters=8)
+        .debugging(seed=0).build())
+best = -1e9
+for i in range(1200):
+    r = algo.step()
+    rm = r.get("episode_reward_mean")
+    if rm is not None:
+        best = max(best, rm)
+    if best >= -600:
+        break
+algo.cleanup()
+assert best >= -600, f"best={best}"
+print("DDPG_LEARNED", best)
+""")
+    assert "DDPG_LEARNED" in out
