@@ -558,6 +558,19 @@ class CoreWorker:
 
     async def get_objects_async(self, refs: List[ObjectRef],
                                 timeout: Optional[float] = None):
+        # Blocked-worker resource release (reference:
+        # raylet_client NotifyDirectCallTaskBlocked/Unblocked): a worker
+        # mid-task that blocks in get() hands its lease's CPUs back to
+        # the raylet so dependent (often CHILD) tasks can schedule —
+        # without this, recursive task trees deadlock once every worker
+        # slot holds a parent blocked on its children.
+        notify = (self.is_worker and self.raylet is not None
+                  and getattr(self, "worker_id_hex", None)
+                  and getattr(self.task_executor, "_current_task_id", None)
+                  is not None)
+        if notify:
+            await self.raylet.notify({"type": "worker_blocked",
+                                      "worker_id": self.worker_id_hex})
         try:
             if timeout is None:
                 return await self._get_objects(refs)
@@ -565,6 +578,14 @@ class CoreWorker:
         except asyncio.TimeoutError:
             raise rex.GetTimeoutError(
                 f"get() timed out after {timeout}s") from None
+        finally:
+            if notify:
+                try:
+                    await self.raylet.notify({"type": "worker_unblocked",
+                                              "worker_id":
+                                              self.worker_id_hex})
+                except Exception:
+                    pass  # raylet gone: the worker is about to die anyway
 
     async def _get_objects(self, refs: List[ObjectRef]):
         # Remote-owned refs need their pulls IN FLIGHT concurrently (a

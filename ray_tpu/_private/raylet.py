@@ -78,6 +78,8 @@ class WorkerHandle:
     busy_since: float = 0.0              # monotonic; OOM-kill ordering
     idle_since: float = 0.0              # monotonic; idle-pool LRU eviction
     actor_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
+    lease_resources: Optional[tuple] = None  # (resources, pg_id, bundle_index)
+    blocked: bool = False        # mid-task, parked in get(): CPUs returned
     actor_created: bool = False  # create_actor completed on this worker
     env_key: str = ""            # runtime-env pool key ("" = default env)
 
@@ -128,6 +130,9 @@ class Raylet:
         # env_key ("" = default) -> idle workers with that runtime env.
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}
         self.pending_leases: List[LeaseRequest] = []
+        # lease_ids whose resources were returned early (worker blocked in
+        # get); _h_return_lease must not return them a second time.
+        self._blocked_leases: set = set()
         # pg bundle pools: (pg_id, bundle_index) -> available resources
         self.bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self._peer_conns: Dict[str, RpcConnection] = {}
@@ -762,6 +767,9 @@ class Raylet:
             raise
         lease_id = os.urandom(8).hex()
         w.lease_id = lease_id
+        w.lease_resources = (dict(req.resources), req.pg_id,
+                             req.bundle_index)
+        w.blocked = False
         w.busy = True
         w.busy_since = time.monotonic()
         # Tag the worker's log streams with the leasing job so drivers can
@@ -777,12 +785,19 @@ class Raylet:
         if msg.get("pg_id") is not None:
             pool = self.bundles.get((msg["pg_id"], msg.get("bundle_index", 0)),
                                     self.resources_available)
-        for k, v in msg.get("resources", {}).items():
-            pool[k] = pool.get(k, 0.0) + v
+        if msg.get("lease_id") in self._blocked_leases:
+            # Resources were already handed back when the worker blocked
+            # in get(); adding again would mint capacity.
+            self._blocked_leases.discard(msg["lease_id"])
+        else:
+            for k, v in msg.get("resources", {}).items():
+                pool[k] = pool.get(k, 0.0) + v
         wid = msg.get("worker_id")
         if wid:
             w = self.workers.get(WorkerID.from_hex(wid))
             if w is not None and w.proc.poll() is None:
+                w.blocked = False
+                w.lease_resources = None
                 w.lease_id = None
                 w.busy = False
                 self.log_monitor.set_job(w.worker_id.hex(), None)
@@ -813,6 +828,40 @@ class Raylet:
                     w.proc.terminate()
                     self.workers.pop(w.worker_id, None)
         await self._dispatch_leases()
+        return {"ok": True}
+
+    async def _h_worker_blocked(self, conn, msg):
+        """Worker mid-task parked in get(): hand its lease's resources
+        back so dependents (often its CHILDREN) can schedule (reference:
+        NotifyDirectCallTaskBlocked -> raylet releases CPU)."""
+        w = self.workers.get(WorkerID.from_hex(msg["worker_id"]))
+        if (w is None or w.blocked or w.lease_id is None
+                or w.lease_resources is None):
+            return {"ok": False}
+        resources, pg_id, bidx = w.lease_resources
+        pool = self.bundles.get((pg_id, bidx), self.resources_available) \
+            if pg_id is not None else self.resources_available
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) + v
+        w.blocked = True
+        self._blocked_leases.add(w.lease_id)
+        await self._dispatch_leases()
+        return {"ok": True}
+
+    async def _h_worker_unblocked(self, conn, msg):
+        """get() returned: re-deduct.  The pool may briefly go negative —
+        deliberate temporary oversubscription, exactly the reference's
+        resume semantics (the resumed task never waits)."""
+        w = self.workers.get(WorkerID.from_hex(msg["worker_id"]))
+        if w is None or not w.blocked or w.lease_resources is None:
+            return {"ok": False}
+        resources, pg_id, bidx = w.lease_resources
+        pool = self.bundles.get((pg_id, bidx), self.resources_available) \
+            if pg_id is not None else self.resources_available
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) - v
+        w.blocked = False
+        self._blocked_leases.discard(w.lease_id)
         return {"ok": True}
 
     async def _dispatch_leases(self):

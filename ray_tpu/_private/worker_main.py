@@ -548,6 +548,7 @@ def main():
     )
     executor = TaskExecutor(core)
     core.task_executor = executor
+    core.worker_id_hex = worker_id   # blocked/unblocked raylet notifies
 
     # Make this process's global_worker usable (nested task submission).
     from ray_tpu._private import worker as worker_mod
