@@ -567,7 +567,13 @@ class CoreWorker:
         notify = (self.is_worker and self.raylet is not None
                   and getattr(self, "worker_id_hex", None)
                   and getattr(self.task_executor, "_current_task_id", None)
-                  is not None)
+                  is not None
+                  # Only when the get will actually wait: an
+                  # already-local fast-path get must not bounce the
+                  # lease's CPUs (the release + re-deduct around an
+                  # instant get would admit an extra task and leave the
+                  # pool oversubscribed for both tasks' lifetimes).
+                  and any(r.hex() not in self.memory_store for r in refs))
         if notify:
             await self.raylet.notify({"type": "worker_blocked",
                                       "worker_id": self.worker_id_hex})

@@ -830,6 +830,14 @@ class Raylet:
         await self._dispatch_leases()
         return {"ok": True}
 
+    def _pool_of(self, pg_id, bundle_index):
+        """Bundle pool when the lease rode a PG bundle, else the node
+        pool (shared by the lease/blocked/death accounting paths)."""
+        if pg_id is not None:
+            return self.bundles.get((pg_id, bundle_index),
+                                    self.resources_available)
+        return self.resources_available
+
     async def _h_worker_blocked(self, conn, msg):
         """Worker mid-task parked in get(): hand its lease's resources
         back so dependents (often its CHILDREN) can schedule (reference:
@@ -839,8 +847,7 @@ class Raylet:
                 or w.lease_resources is None):
             return {"ok": False}
         resources, pg_id, bidx = w.lease_resources
-        pool = self.bundles.get((pg_id, bidx), self.resources_available) \
-            if pg_id is not None else self.resources_available
+        pool = self._pool_of(pg_id, bidx)
         for k, v in resources.items():
             pool[k] = pool.get(k, 0.0) + v
         w.blocked = True
@@ -856,8 +863,7 @@ class Raylet:
         if w is None or not w.blocked or w.lease_resources is None:
             return {"ok": False}
         resources, pg_id, bidx = w.lease_resources
-        pool = self.bundles.get((pg_id, bidx), self.resources_available) \
-            if pg_id is not None else self.resources_available
+        pool = self._pool_of(pg_id, bidx)
         for k, v in resources.items():
             pool[k] = pool.get(k, 0.0) - v
         w.blocked = False

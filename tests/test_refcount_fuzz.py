@@ -13,7 +13,6 @@ import gc
 import random
 
 import numpy as np
-import pytest
 
 import ray_tpu
 
