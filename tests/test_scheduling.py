@@ -123,3 +123,21 @@ def test_pg_packs_tpu_bundles_within_one_slice():
             f"bundles split across slices: {pg.allocations}")
 
     asyncio.run(run())
+
+
+def test_recursive_tasks_deeper_than_cpu_count():
+    """Recursive task trees must not deadlock when every CPU slot holds a
+    parent blocked in get() (blocked-worker resource release; reference:
+    NotifyDirectCallTaskBlocked).  depth 5 > num_cpus=2."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, _worker_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        @ray_tpu.remote
+        def rec(depth):
+            if depth <= 0:
+                return 1
+            return 1 + ray_tpu.get(rec.remote(depth - 1))
+
+        assert ray_tpu.get(rec.remote(5), timeout=120) == 6
+    finally:
+        ray_tpu.shutdown()
