@@ -216,7 +216,11 @@ def child_main() -> None:
         params = gpt_init(jax.random.PRNGKey(0), cfg)
         n_params = sum(int(p.size) for p in jax.tree.leaves(params))
         params = shard_params(params, mesh, rules, gpt_param_axes(cfg))
-        tx = optax.adamw(3e-4, b2=0.95)
+        # RT_BENCH_MU_DTYPE=bfloat16 stores the first moment in bf16
+        # (halves its HBM traffic; v is kept f32 for numerics).
+        mu_dtype = getattr(jnp, os.environ.get("RT_BENCH_MU_DTYPE", ""),
+                           None)
+        tx = optax.adamw(3e-4, b2=0.95, mu_dtype=mu_dtype)
         opt_state = tx.init(params)
         step = make_train_step(cfg, tx, rules)
         tokens = jax.random.randint(
