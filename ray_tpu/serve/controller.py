@@ -191,9 +191,22 @@ class ServeController:
         await self._reconcile_once()
         return True
 
-    async def _kill_replica(self, handle):
-        """Async kill: the blocking ray_tpu.kill would deadlock the actor
+    async def _kill_replica(self, handle, drain_s: float = 10.0):
+        """Drain then kill (reference: replica graceful shutdown —
+        deployment_state waits for in-flight requests before stopping).
+        Bounded: a wedged request must not block scale-down forever.
+        Async kill: the blocking ray_tpu.kill would deadlock the actor
         loop this controller runs on."""
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            try:
+                n = await asyncio.wait_for(handle.queue_len.remote(),
+                                           timeout=2)
+                if n == 0:
+                    break
+            except Exception:
+                break   # dead/unreachable: nothing to drain
+            await asyncio.sleep(0.1)
         from ray_tpu._private.worker import get_core
         try:
             await get_core().gcs.request({"type": "kill_actor",
@@ -209,9 +222,12 @@ class ServeController:
         async with self._reconcile_lock:
             self.deployments.pop(name, None)
             self.targets.pop(name, None)
-            for r in self.replicas.pop(name, []):
-                await self._kill_replica(r)
+            victims = self.replicas.pop(name, [])
+            # Routers stop sending FIRST (long-poll push), then drain:
+            # draining a replica that still receives traffic never ends.
             self._bump_version(name)
+            for r in victims:
+                await self._kill_replica(r)
         return True
 
     async def status(self) -> Dict[str, Any]:
@@ -320,10 +336,13 @@ class ServeController:
                         max_concurrency=4 * spec.max_concurrent_queries + 8,
                         name=f"_serve:{name}:{self._replica_seq}")
                     reps.append(ActorHandle(actor_id, "Replica"))
+                victims = []
                 while len(reps) > target:
-                    await self._kill_replica(reps.pop())
+                    victims.append(reps.pop())
                 if [r._actor_id for r in reps] != before:
-                    self._bump_version(name)
+                    self._bump_version(name)   # before draining victims
+                for v in victims:
+                    await self._kill_replica(v)
 
     async def _autoscale(self):
         """Queue-depth autoscaling (reference: autoscaling_policy.py:93)."""

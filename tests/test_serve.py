@@ -371,3 +371,25 @@ def test_user_config_reconfigure_without_restart(serve_cluster):
     assert v == 70
     assert pid2 == pid1, "replica restarted on a config-only change"
     serve.delete("Scaler")
+
+
+def test_scale_down_drains_in_flight_requests(serve_cluster):
+    """Replica removal drains in-flight requests before the kill
+    (reference: graceful replica shutdown); routers are version-bumped
+    off the victim first so the drain can finish."""
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4,
+                      ray_actor_options={"num_cpus": 0.1})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(1.5)
+            return x * 2
+
+    h = serve.run(Slow.bind())
+    inflight = [h.remote(i) for i in range(6)]
+    time.sleep(0.3)                      # requests land on both replicas
+    h2 = serve.run(Slow.options(num_replicas=1).bind())  # scale down
+    # Every in-flight request must complete despite the kill.
+    assert sorted(ray_tpu.get(inflight, timeout=60)) == \
+        [0, 2, 4, 6, 8, 10]
+    assert ray_tpu.get(h2.remote(21), timeout=30) == 42
+    serve.delete("Slow")
