@@ -48,10 +48,21 @@ class Worker:
         object_store_memory: Optional[int] = None,
         log_level: str = "WARNING",
         log_to_driver: bool = True,
+        local_mode: bool = False,
         _worker_env: Optional[Dict[str, str]] = None,
         _system_config: Optional[Dict[str, Any]] = None,
     ):
         if self.connected:
+            return self.connection_info()
+        if local_mode:
+            # Inline debugging mode (reference: ray.init(local_mode=True)):
+            # no daemons; tasks/actors run synchronously in this process.
+            from ray_tpu._private.local_mode import LocalModeCore
+            core = LocalModeCore()
+            self.attach_core(core, mode="local")
+            self.namespace = namespace or "default"
+            self.job_id = "local"
+            self._ready_info = core.connection_info()
             return self.connection_info()
         # Config overrides (reference: ray.init(_system_config=...)): apply
         # to this process and export so daemons/workers inherit the view.
@@ -181,6 +192,11 @@ class Worker:
             atexit.unregister(self.shutdown)
         except Exception:
             pass
+        if self.core_worker is not None and self.mode == "local":
+            self.core_worker.shutdown()
+            self.core_worker = None
+            self._ready_info = None
+            return
         if self.core_worker is not None and self.mode == "driver":
             # Local-only usage snapshot (reference usage_lib, minus the
             # phone-home: this environment has no egress by design).

@@ -180,3 +180,57 @@ def test_get_runtime_context(ray_start):
     a = A.remote()
     d = ray_tpu.get(a.who.remote())
     assert d["actor_id"]
+
+
+def test_local_mode_inline_execution():
+    """ray.init(local_mode=True) analog: tasks/actors run inline, errors
+    surface at get(), dynamic returns work, named actors resolve."""
+    import ray_tpu as rt
+    rt.shutdown()
+    info = rt.init(local_mode=True)
+    try:
+        assert info.get("local_mode") is True
+
+        calls = []
+
+        @rt.remote
+        def f(x):
+            calls.append(x)     # proof of in-process execution
+            return x + 1
+
+        r = f.remote(1)
+        assert calls == [1]     # ran synchronously at .remote()
+        assert rt.get(r) == 2
+        assert rt.get(f.remote(rt.put(10))) == 11
+
+        @rt.remote
+        def boom():
+            raise ValueError("inline boom")
+
+        ref = boom.remote()
+        with pytest.raises(ValueError, match="inline boom"):
+            rt.get(ref)
+
+        @rt.remote(num_returns="dynamic")
+        def gen(n):
+            yield from range(n)
+
+        assert rt.get(list(rt.get(gen.remote(3)))) == [0, 1, 2]
+
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="cnt").remote()
+        assert rt.get(c.inc.remote()) == 1
+        c2 = rt.get_actor("cnt")
+        assert rt.get(c2.inc.remote()) == 2
+        ready, rest = rt.wait([rt.put(1), rt.put(2)])
+        assert len(ready) == 1 and len(rest) == 1
+    finally:
+        rt.shutdown()
