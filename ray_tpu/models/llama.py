@@ -47,7 +47,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     remat_policy: str = "full"   # same menu as GPTConfig
-    attention: str = "dense"         # "dense" | "flash"
+    attention: str = "auto"          # "auto" | "dense" | "flash"
 
     @property
     def head_dim(self) -> int:
@@ -214,7 +214,11 @@ def llama_forward(params: Dict[str, Any], tokens: jax.Array,
     loss upcasts inside its reductions, same contract as gpt_forward)."""
     dt = cfg.dtype
     S = tokens.shape[1]
-    if cfg.attention == "flash":
+    attention = cfg.attention
+    if attention == "auto":
+        from ray_tpu.models.gpt import _flash_profitable
+        attention = "flash" if _flash_profitable(S) else "dense"
+    if attention == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 
         def attn_fn(q, k, v):

