@@ -274,7 +274,59 @@ def child_main() -> None:
             result.update(_longctx_point())
         except Exception as e:  # long-context point is best-effort
             _log(f"bench: longctx point failed: {e!r}")
+        if os.environ.get("RT_BENCH_LLAMA", "1") == "1":
+            try:
+                result.update(_llama_point(n, peak))
+            except Exception as e:  # second family is best-effort
+                _log(f"bench: llama point failed: {e!r}")
     print(json.dumps(result))
+
+
+def _llama_point(n_chips: int, peak: float, B: int = 32, S: int = 1024,
+                 iters: int = 8) -> dict:
+    """Second model family on the same chip: LLaMA-125M-class (RoPE,
+    RMSNorm, SwiGLU, GQA 12q/4kv) train samples/s + MFU."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import (LlamaConfig, llama_init,
+                                      llama_param_axes, make_train_step)
+    from ray_tpu.parallel import LogicalAxisRules, MeshSpec
+    from ray_tpu.parallel.sharding import shard_params
+
+    cfg = LlamaConfig(max_seq_len=S, remat=True, remat_policy="dots",
+                      attention="flash")
+    spec = MeshSpec.for_devices(len(jax.devices()))
+    mesh = spec.build()
+    rules = LogicalAxisRules.for_transformer(spec)
+    with jax.sharding.set_mesh(mesh):
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        n_params = sum(int(p.size) for p in jax.tree.leaves(params))
+        params = shard_params(params, mesh, rules, llama_param_axes(cfg))
+        tx = optax.adamw(3e-4, b2=0.95)
+        opt_state = tx.init(params)
+        step = make_train_step(cfg, tx, rules)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0,
+                                    cfg.vocab_size, jnp.int32)
+        batch = {"tokens": tokens}
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, batch)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, m = step(params, opt_state, batch)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+    sps = iters * B / dt
+    flops_per_token = (6.0 * n_params
+                       + 12.0 * cfg.num_layers * S * cfg.embed_dim)
+    return {
+        "llama_samples_per_sec_per_chip": round(sps / n_chips, 3),
+        "llama_mfu": round(flops_per_token * sps * S / (n_chips * peak),
+                           4),
+        "llama_n_params_m": round(n_params / 1e6, 1),
+    }
 
 
 def _longctx_point(S: int = 4096, B: int = 2, N: int = 12, H: int = 64,
