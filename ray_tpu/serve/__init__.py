@@ -89,6 +89,7 @@ def _controller() -> "ray_tpu.actor.ActorHandle":
         # asyncio-unbounded); parked polls cost memory, not CPU.
         return actor_cls.options(name=CONTROLLER_NAME, lifetime="detached",
                                  get_if_exists=True, num_cpus=0.1,
+                                 max_restarts=-1,
                                  max_concurrency=512).remote()
 
 

@@ -124,6 +124,39 @@ class ServeController:
         # LongPollHost): per-deployment replica-set version + waiter event.
         self._versions: Dict[str, int] = {}
         self._change_events: Dict[str, asyncio.Event] = {}
+        self._restored = False
+
+    async def _maybe_restore(self):
+        """Crash recovery (reference: the controller checkpoints its
+        state and recovers on restart): a GCS-restarted controller
+        re-adopts its deployments AND the still-live replica actors from
+        the KV snapshot written each reconcile — replicas keep serving
+        through the crash; reconcile then replaces any that died."""
+        if self._restored:
+            return
+        self._restored = True
+        try:
+            import cloudpickle
+            from ray_tpu._private.worker import get_core
+            from ray_tpu.actor import ActorHandle
+            raw = await get_core().gcs.request(
+                {"type": "kv_get", "ns": "serve", "key": b"state"})
+            if not raw:
+                return
+            state = cloudpickle.loads(raw)
+            self._replica_seq = state.get("replica_seq", 0)
+            for name, (spec, target, replica_ids) in \
+                    state.get("deployments", {}).items():
+                self.deployments[name] = spec
+                self.targets[name] = target
+                self.replicas[name] = [ActorHandle(a, "Replica")
+                                       for a in replica_ids]
+                self._bump_version(name)   # routers refresh handles
+            if self.deployments:
+                logger.info("serve controller restored %d deployments "
+                            "from KV", len(self.deployments))
+        except Exception:
+            logger.exception("serve controller state restore failed")
 
     def _bump_version(self, name: str):
         self._versions[name] = self._versions.get(name, 0) + 1
@@ -133,6 +166,8 @@ class ServeController:
 
     async def listen_for_change(self, name: str, last_version: int,
                                 timeout: float = 30.0) -> Dict[str, Any]:
+        await self._maybe_restore()
+        await self._ensure_loop()
         """Long-poll: parks until the deployment's replica set differs from
         ``last_version`` (or timeout), then returns the current snapshot.
         Routers learn about scale events push-style instead of waiting out
@@ -161,6 +196,7 @@ class ServeController:
         reference rolls replicas on version change,
         deployment_state.py:959)."""
         await self._ensure_loop()
+        await self._maybe_restore()
         old = self.deployments.get(spec.name)
         code_changed = old is not None and (
             old.callable_blob != spec.callable_blob or
@@ -219,6 +255,7 @@ class ServeController:
         # Under the reconcile lock: an in-flight reconcile that already
         # snapshotted this deployment would otherwise recreate (and orphan)
         # replicas right after we kill them.
+        await self._maybe_restore()
         async with self._reconcile_lock:
             self.deployments.pop(name, None)
             self.targets.pop(name, None)
@@ -231,6 +268,7 @@ class ServeController:
         return True
 
     async def status(self) -> Dict[str, Any]:
+        await self._maybe_restore()
         return {
             name: {
                 "target": self.targets.get(name, 0),
@@ -242,6 +280,8 @@ class ServeController:
 
     async def get_replicas(self, name: str) -> List:
         """Replica handles for the router (cached client-side)."""
+        await self._maybe_restore()
+        await self._ensure_loop()   # a restarted controller reconciles
         return list(self.replicas.get(name, []))
 
     async def routes(self) -> Dict[str, str]:
@@ -259,6 +299,7 @@ class ServeController:
     # ------------------------------------------------------------ internals
 
     async def _reconcile_loop(self):
+        await self._maybe_restore()
         while not self._shutdown:
             try:
                 await self._reconcile_once()
@@ -292,6 +333,18 @@ class ServeController:
             "value": _json.dumps({"deployments": status,
                                   "updated_at": _time.time()}).encode(),
             "overwrite": True})
+        import cloudpickle
+        state = {
+            "replica_seq": self._replica_seq,
+            "deployments": {
+                name: (spec, self.targets.get(name, 0),
+                       [r._actor_id for r in self.replicas.get(name, [])])
+                for name, spec in self.deployments.items()
+            },
+        }
+        await get_core().gcs.request({
+            "type": "kv_put", "ns": "serve", "key": b"state",
+            "value": cloudpickle.dumps(state), "overwrite": True})
 
     async def _reconcile_once(self):
         from ray_tpu._private.worker import get_core
