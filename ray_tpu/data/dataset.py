@@ -666,6 +666,42 @@ class Dataset:
                        for b in self._blocks]
         return Dataset([merge_task.remote(key, descending, *sorted_refs)])
 
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at global row indices (reference:
+        ``Dataset.split_at_indices``): ``[3, 8]`` -> rows [0,3), [3,8),
+        [8, n)."""
+        bounds = [0] + sorted(indices) + [self.count()]
+        metas = self._meta()
+        starts = []   # cumulative start row of each block
+        acc = 0
+        for m in metas:
+            starts.append(acc)
+            acc += m.num_rows
+        slice_task = ray_tpu.remote(_slice_block)
+        out = []
+        for lo, hi in builtins.zip(bounds, bounds[1:]):
+            refs = []
+            for (ref, m, s) in builtins.zip(self._blocks, metas, starts):
+                a, b = builtins.max(lo, s), builtins.min(hi, s + m.num_rows)
+                if a >= b:
+                    continue
+                refs.append(ref if (a == s and b == s + m.num_rows)
+                            else slice_task.remote(ref, a - s, b - s))
+            out.append(Dataset(refs))
+        return out
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None) -> tuple:
+        """(train, test) datasets (reference: Dataset.train_test_split).
+        ``test_size`` is a fraction of rows."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        cut = n - int(n * test_size)
+        train, test = ds.split_at_indices([cut])
+        return train, test
+
     def limit(self, n: int) -> "Dataset":
         metas = self._meta()
         slice_task = ray_tpu.remote(_slice_block)

@@ -337,3 +337,19 @@ def test_column_operations(ray_start):
     assert [r["s"] for r in with_sum.take(3)] == [0, 3, 6]
     ren = ds.rename_columns({"a": "x"})
     assert set(ren.take(1)[0]) == {"x", "b", "c"}
+
+
+def test_split_at_indices_and_train_test_split(ray_start):
+    ds = rd.range(20, parallelism=3)
+    a, b, c = ds.split_at_indices([5, 12])
+    assert a.take_all() == list(range(5))
+    assert b.take_all() == list(range(5, 12))
+    assert c.take_all() == list(range(12, 20))
+    # Degenerate cuts at block boundaries and 0.
+    x, y = ds.split_at_indices([0])
+    assert x.take_all() == [] and y.count() == 20
+    train, test = ds.train_test_split(0.25)
+    assert train.count() == 15 and test.count() == 5
+    tr2, te2 = ds.train_test_split(0.3, shuffle=True, seed=5)
+    assert sorted(tr2.take_all() + te2.take_all()) == list(range(20))
+    assert te2.count() == 6
