@@ -74,7 +74,8 @@ class _JobSupervisor:
     poll."""
 
     def __init__(self, submission_id: str, entrypoint: str,
-                 env: Optional[Dict[str, str]], log_path: str):
+                 env: Optional[Dict[str, str]], log_path: str,
+                 runtime_env: Optional[dict] = None):
         self.submission_id = submission_id
         self.entrypoint = entrypoint
         self.log_path = log_path
@@ -90,13 +91,37 @@ class _JobSupervisor:
         if penv.get("PYTHONPATH"):
             extra.append(penv["PYTHONPATH"])
         penv["PYTHONPATH"] = os.pathsep.join(extra)
+        cwd = None
+        if runtime_env:
+            # Job-level runtime env (reference: ray job submit
+            # --runtime-env): working_dir becomes the entrypoint's cwd,
+            # py_modules/pip site dirs prepend its PYTHONPATH, env_vars
+            # merge — the same normalized/content-addressed layout the
+            # worker path uses (runtime_env/runtime_env.py).
+            import tempfile
+
+            from ray_tpu.runtime_env.runtime_env import PKG_NS, materialize
+            from ray_tpu._private.worker import get_core
+
+            def _kv_get(key):
+                return get_core().gcs_request(
+                    {"type": "kv_get", "ns": PKG_NS, "key": key})
+
+            mat = materialize(runtime_env, _kv_get, os.path.join(
+                tempfile.gettempdir(), "rt_runtime_env"))
+            if mat["paths"]:
+                penv["PYTHONPATH"] = os.pathsep.join(
+                    list(mat["paths"]) + [penv["PYTHONPATH"]])
+            cwd = mat["workdir"] or None
+            penv.update(runtime_env.get("env_vars", {}))
+        self._cwd = cwd
         self._log_f = open(log_path, "wb")
         info = _get_info(submission_id) or JobInfo(submission_id, entrypoint)
         info.status = JobStatus.RUNNING
         info.start_time = time.time()
         _put_info(info)
         self.proc = subprocess.Popen(
-            entrypoint, shell=True, env=penv,
+            entrypoint, shell=True, env=penv, cwd=self._cwd,
             stdout=self._log_f, stderr=subprocess.STDOUT,
             start_new_session=True)
 
@@ -152,7 +177,8 @@ class JobManager:
     def submit_job(self, entrypoint: str, *,
                    submission_id: Optional[str] = None,
                    env: Optional[Dict[str, str]] = None,
-                   metadata: Optional[Dict[str, str]] = None) -> str:
+                   metadata: Optional[Dict[str, str]] = None,
+                   runtime_env: Optional[dict] = None) -> str:
         submission_id = submission_id or f"rtjob_{uuid.uuid4().hex[:10]}"
         if _get_info(submission_id) is not None:
             raise ValueError(f"job {submission_id} already exists")
@@ -163,7 +189,8 @@ class JobManager:
         sup = _JobSupervisor.options(
             name=f"_rt_job_supervisor_{submission_id}",
             lifetime="detached",
-        ).remote(submission_id, entrypoint, env, log_path)
+        ).remote(submission_id, entrypoint, env, log_path,
+                 runtime_env=runtime_env)
         # Surface immediate spawn failures synchronously.
         ray_tpu.get(sup.poll.remote(), timeout=60)
         return submission_id

@@ -25,9 +25,16 @@ class JobSubmissionClient:
                    runtime_env: Optional[dict] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
         env = dict((runtime_env or {}).get("env_vars", {}))
+        norm = None
+        if runtime_env:
+            # Normalize driver-side: local working_dir/py_modules upload
+            # to the GCS KV by content here so the (possibly remote)
+            # supervisor can materialize them anywhere.
+            from ray_tpu.runtime_env import normalize_runtime_env
+            norm = normalize_runtime_env(runtime_env)
         return self._manager.submit_job(
             entrypoint, submission_id=submission_id, env=env,
-            metadata=metadata)
+            metadata=metadata, runtime_env=norm)
 
     def get_job_status(self, submission_id: str) -> str:
         return self._manager.get_job_status(submission_id)

@@ -105,3 +105,36 @@ def test_cli_start_status_stop():
         r = cli("stop")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "stopped" in r.stdout
+
+
+def test_job_runtime_env_working_dir_and_py_modules(tmp_path):
+    """Job-level runtime_env (reference: ray job submit --runtime-env):
+    the entrypoint runs inside the shipped working_dir with py_modules
+    importable and env_vars set."""
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload42")
+    mod = tmp_path / "mymod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 123\n")
+
+    ray_tpu.init(num_cpus=2, _worker_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        client = JobSubmissionClient()
+        sid = client.submit_job(
+            entrypoint=(
+                "python -c \"import os, mymod; "
+                "print('WD', open('data.txt').read(), mymod.MAGIC, "
+                "os.environ['JOB_FLAVOR'])\""),
+            runtime_env={"working_dir": str(wd),
+                         "py_modules": [str(mod)],
+                         "env_vars": {"JOB_FLAVOR": "vanilla"}})
+        status = client.wait_until_finished(sid, timeout=120)
+        logs = client.get_job_logs(sid)
+        assert status == "SUCCEEDED", logs
+        assert "WD payload42 123 vanilla" in logs
+    finally:
+        ray_tpu.shutdown()
