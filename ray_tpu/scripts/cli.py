@@ -288,7 +288,11 @@ def cmd_job(args):
         ep = args.entrypoint
         if ep and ep[0] == "--":
             ep = ep[1:]
-        sid = client.submit_job(entrypoint=shlex.join(ep))
+        renv = None
+        if getattr(args, "runtime_env_json", None):
+            renv = json.loads(args.runtime_env_json)
+        sid = client.submit_job(entrypoint=shlex.join(ep),
+                                runtime_env=renv)
         print(f"submitted: {sid}")
         if args.wait:
             status = client.wait_until_finished(sid, timeout=args.timeout)
@@ -398,6 +402,9 @@ def main(argv=None):
     j.add_argument("--address")
     j.add_argument("--wait", action="store_true")
     j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("--runtime-env-json", dest="runtime_env_json",
+                   help='JSON runtime env, e.g. '
+                        '\'{"working_dir": ".", "pip": [...]}\'')
     j.add_argument("entrypoint", nargs=argparse.REMAINDER,
                    help="shell command to run as the job driver")
     for name in ["status", "logs", "stop"]:
