@@ -56,6 +56,12 @@ class TpuTopology:
         if self.num_local_chips == 0:
             return {}
         res: Dict[str, float] = {"TPU": float(self.num_local_chips)}
+        if self.generation:
+            # accelerator_type constraint resource (reference:
+            # util/accelerators + resource "accelerator_type:<T>"):
+            # tasks declaring accelerator_type="v5e" request a sliver.
+            res[f"accelerator_type:{self.generation}"] = \
+                float(self.num_local_chips)
         if self.slice_name:
             res[f"tpu-slice:{self.slice_name}"] = 1.0
         if self.host_index == 0 and self.generation:

@@ -29,6 +29,7 @@ _ACTOR_DEFAULTS = dict(
     runtime_env=None,
     num_returns=1,
     concurrency_groups=None,
+    accelerator_type=None,
 )
 
 

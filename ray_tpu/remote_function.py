@@ -20,6 +20,7 @@ _DEFAULTS = dict(
     retry_exceptions=False,
     scheduling_strategy=None,
     runtime_env=None,
+    accelerator_type=None,
     name=None,
 )
 
@@ -30,6 +31,10 @@ def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
         res["CPU"] = float(opts["num_cpus"])
     if opts.get("num_tpus"):
         res["TPU"] = float(opts["num_tpus"])
+    if opts.get("accelerator_type"):
+        # Constrain placement to nodes advertising this TPU generation
+        # (reference: @ray.remote(accelerator_type=...)).
+        res[f"accelerator_type:{opts['accelerator_type']}"] = 0.001
     return res
 
 

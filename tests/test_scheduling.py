@@ -141,3 +141,35 @@ def test_recursive_tasks_deeper_than_cpu_count():
         assert ray_tpu.get(rec.remote(5), timeout=120) == 6
     finally:
         ray_tpu.shutdown()
+
+
+def test_accelerator_type_constraint():
+    """@remote(accelerator_type=...) schedules only onto nodes
+    advertising that TPU generation (reference: ray.util.accelerators)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(head_node_args={
+        "num_cpus": 1,
+        "resources": {"accelerator_type:v5e": 4.0}})
+    try:
+        ray_tpu.init(address=cluster.address,
+                     _worker_env={"JAX_PLATFORMS": "cpu"})
+
+        @ray_tpu.remote(accelerator_type="v5e", num_cpus=0.1)
+        def where():
+            import os
+            return os.environ.get("RT_NODE_ID")
+
+        assert ray_tpu.get(where.remote(), timeout=60)
+
+        # A generation nobody advertises fails fast (this runtime's
+        # designed infeasible-forever semantics) with a clear error.
+        @ray_tpu.remote(accelerator_type="v9x", num_cpus=0.1)
+        def nope():
+            return 1
+
+        with pytest.raises(Exception):
+            ray_tpu.get(nope.remote(), timeout=30)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
