@@ -171,6 +171,7 @@ def cmd_list(args):
         "tasks": state.list_tasks,
         "objects": state.list_objects,
         "placement-groups": state.list_placement_groups,
+        "workers": state.list_workers,
     }[args.what]
     rows = fn()
     print(json.dumps(rows[:args.limit], indent=2, default=str))
@@ -343,7 +344,7 @@ def main(argv=None):
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("what", choices=["nodes", "actors", "tasks", "objects",
-                                     "placement-groups"])
+                                     "placement-groups", "workers"])
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)

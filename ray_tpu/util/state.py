@@ -42,6 +42,17 @@ def list_tasks(limit: int = 20000, *, offset: int = 0,
                          "kind": kind, "trace_id": trace_id})
 
 
+def list_workers() -> List[Dict[str, Any]]:
+    """Per-node worker processes (pid, cpu, rss, role) from the raylet
+    stats stream (reference: `ray list workers` over per-node agents)."""
+    stats = _gcs_request({"type": "get_node_stats"}) or {}
+    out: List[Dict[str, Any]] = []
+    for node_id, s in stats.items():
+        for w in s.get("workers", []):
+            out.append({"node_id": node_id, **w})
+    return out
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects registered in the cluster object directory (plasma-sized;
     inline objects live in their owners and are not globally tracked)."""

@@ -151,3 +151,24 @@ def test_metrics_aggregate_across_workers(obs_cluster):
         return vals[0]["value"] if vals else 0
 
     _wait_for(lambda: total() == 3.0)
+
+
+def test_list_workers(ray_start):
+    """state.list_workers surfaces per-node worker processes."""
+    import time as _t
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    assert ray_tpu.get(warm.remote()) == 1
+    deadline = _t.monotonic() + 30
+    workers = []
+    while _t.monotonic() < deadline:
+        workers = state.list_workers()
+        if workers:
+            break
+        _t.sleep(0.5)
+    assert workers and all("pid" in w and "node_id" in w for w in workers)
