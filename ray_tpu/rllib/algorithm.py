@@ -100,6 +100,58 @@ class Algorithm(Trainable):
         result["num_env_steps_sampled"] = self._timesteps_total
         return result
 
+    def evaluate(self, num_episodes: int = 10,
+                 timeout_s: float = 300.0) -> Dict[str, Any]:
+        """Run evaluation episodes with the CURRENT policy on a fresh env
+        (reference: ``Algorithm.evaluate`` / evaluation workers).  Uses
+        its own env instance so training-side episode metrics and env
+        state are untouched."""
+        import time as _time
+
+        import numpy as _np
+
+        from ray_tpu.rllib.env import make_vector_env
+        cfg = self.config
+        env = make_vector_env(cfg["env"], 1,
+                              seed=cfg.get("seed", 0) + 977,
+                              **cfg.get("env_config", {}))
+        policy = self.workers.local_worker.policy
+        rewards, lens = [], []
+        deadline = _time.monotonic() + timeout_s
+        for _ in range(num_episodes):
+            if _time.monotonic() > deadline:
+                break
+            if hasattr(policy, "_ensure_state"):
+                policy._ensure_state(1)
+                policy.notify_dones(_np.array([True]))
+            obs = env.vector_reset()
+            total, steps = 0.0, 0
+            for _ in range(cfg.get("evaluation_max_steps", 1000)):
+                out = policy.compute_actions(
+                    _np.asarray(obs, _np.float32))
+                obs, rew, done, _info = env.vector_step(out["actions"])
+                total += float(rew[0])
+                steps += 1
+                if hasattr(policy, "notify_dones"):
+                    policy.notify_dones(done)
+                if bool(done[0]):
+                    break
+            rewards.append(total)
+            lens.append(steps)
+        return {
+            "evaluation": {
+                "episode_reward_mean": float(_np.mean(rewards))
+                if rewards else float("nan"),
+                "episode_reward_min": float(_np.min(rewards))
+                if rewards else float("nan"),
+                "episode_reward_max": float(_np.max(rewards))
+                if rewards else float("nan"),
+                "episode_len_mean": float(_np.mean(lens))
+                if lens else float("nan"),
+                "num_episodes": len(rewards),
+            }
+        }
+
     # -- checkpointing (Trainable contract) -------------------------------
     def save_checkpoint(self) -> Dict[str, Any]:
         return {"weights": self.workers.local_worker.get_weights(),

@@ -254,3 +254,24 @@ def test_algorithm_checkpoint_roundtrip():
     w1 = algo2.get_policy().get_weights()
     np.testing.assert_allclose(w0["pi"]["w"], w1["pi"]["w"], rtol=1e-6)
     algo2.stop()
+
+
+def test_algorithm_evaluate():
+    """Algorithm.evaluate runs isolated evaluation episodes (reference:
+    Algorithm.evaluate) without touching training metrics or env state."""
+    algo = (PPOConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0, num_envs_per_worker=2,
+                      rollout_fragment_length=8)
+            .debugging(seed=0).build())
+    try:
+        algo.train()
+        before = algo.workers.local_worker.get_metrics()
+        ev = algo.evaluate(num_episodes=3)["evaluation"]
+        assert ev["num_episodes"] == 3
+        assert ev["episode_reward_min"] <= ev["episode_reward_mean"] \
+            <= ev["episode_reward_max"]
+        assert ev["episode_len_mean"] >= 1
+        after = algo.workers.local_worker.get_metrics()
+        assert before == after, "evaluate polluted training metrics"
+    finally:
+        algo.stop()
