@@ -353,3 +353,19 @@ def test_split_at_indices_and_train_test_split(ray_start):
     tr2, te2 = ds.train_test_split(0.3, shuffle=True, seed=5)
     assert sorted(tr2.take_all() + te2.take_all()) == list(range(20))
     assert te2.count() == 6
+
+
+def test_push_shuffle_preserves_block_count_when_mergers_capped(ray_start):
+    """With more blocks than 2*CPUs, mergers are capped but the output
+    must still have len(blocks) blocks (zip/split alignment contracts).
+    CPU count is patched small so the cap engages without spawning a
+    32-actor gang on the 1-core CI box."""
+    import unittest.mock as um
+
+    import ray_tpu as _rt
+    ds = rd.range(60, parallelism=12)
+    with um.patch.object(_rt, "cluster_resources",
+                         return_value={"CPU": 2.0}):
+        out = ds.random_shuffle(seed=3)   # mergers capped at 4
+    assert out.num_blocks() == 12
+    assert sorted(out.take_all()) == list(range(60))
