@@ -203,9 +203,13 @@ def child_main() -> None:
     # 0.417) vs 91.4 full / 92.0 attn / 90.4 attn_dots; B=48+ OOMs, B=40
     # regresses (fragmentation), remat off OOMs at any useful batch.
     policy = os.environ.get("RT_BENCH_REMAT_POLICY", "dots")
+    # Blocked CE head (r5): head matmul + CE per 256-token chunk, never
+    # materializing [B,S,V].  RT_BENCH_CE_BLOCK=0 restores the full head.
+    ce_block = int(os.environ.get("RT_BENCH_CE_BLOCK",
+                                  256 if on_tpu else 0))
     cfg = type(cfg)(**{**cfg.__dict__, "max_seq_len": seq,
                        "attention": attn, "remat": remat,
-                       "remat_policy": policy})
+                       "remat_policy": policy, "ce_block": ce_block})
 
     n = len(devices)
     spec = MeshSpec.for_devices(n)
@@ -296,7 +300,8 @@ def _llama_point(n_chips: int, peak: float, B: int = 32, S: int = 1024,
     from ray_tpu.parallel.sharding import shard_params
 
     cfg = LlamaConfig(max_seq_len=S, remat=True, remat_policy="dots",
-                      attention="flash")
+                      attention="flash",
+                      ce_block=int(os.environ.get("RT_BENCH_CE_BLOCK", 256)))
     spec = MeshSpec.for_devices(len(jax.devices()))
     mesh = spec.build()
     rules = LogicalAxisRules.for_transformer(spec)

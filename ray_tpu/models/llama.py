@@ -48,6 +48,7 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "full"   # same menu as GPTConfig
     attention: str = "auto"          # "auto" | "dense" | "flash"
+    ce_block: int = 0                # blocked-CE chunk (see GPTConfig)
 
     @property
     def head_dim(self) -> int:
@@ -206,12 +207,12 @@ def _block(cfg: LlamaConfig, rules: Optional[LogicalAxisRules],
     return lc(x, ("batch", "seq", "embed"))
 
 
-def llama_forward(params: Dict[str, Any], tokens: jax.Array,
-                  cfg: LlamaConfig,
-                  rules: Optional[LogicalAxisRules] = None,
-                  mesh=None) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V] (compute dtype; the fused
-    loss upcasts inside its reductions, same contract as gpt_forward)."""
+def llama_hidden(params: Dict[str, Any], tokens: jax.Array,
+                 cfg: LlamaConfig,
+                 rules: Optional[LogicalAxisRules] = None,
+                 mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> final hidden [B, S, D] after rms_norm (compute
+    dtype) — the trunk without the LM head (see gpt_hidden)."""
     dt = cfg.dtype
     S = tokens.shape[1]
     attention = cfg.attention
@@ -250,16 +251,32 @@ def llama_forward(params: Dict[str, Any], tokens: jax.Array,
 
     x, _ = jax.lax.scan(lambda c, lp: (block(c, lp), None), x,
                         params["layers"])
-    x = _rms_norm(x, params["ln_f"]["scale"], cfg.rms_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(dt))
+    return _rms_norm(x, params["ln_f"]["scale"], cfg.rms_eps)
+
+
+def llama_forward(params: Dict[str, Any], tokens: jax.Array,
+                  cfg: LlamaConfig,
+                  rules: Optional[LogicalAxisRules] = None,
+                  mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (compute dtype; the fused
+    loss upcasts inside its reductions, same contract as gpt_forward)."""
+    x = llama_hidden(params, tokens, cfg, rules, mesh)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
 
 
 def llama_loss(params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
                rules: Optional[LogicalAxisRules] = None,
                mesh=None) -> jax.Array:
     """Next-token CE over {"tokens": [B, S+1]} — shares the fused
-    ``token_loglikes`` core with GPT."""
+    ``token_loglikes`` core (and the blocked-CE head via ``cfg.ce_block``)
+    with GPT."""
     toks = batch["tokens"]
+    if cfg.ce_block:
+        from ray_tpu.models.gpt import blocked_ce_loglike_sum
+        x = llama_hidden(params, toks[:, :-1], cfg, rules, mesh)
+        return -blocked_ce_loglike_sum(
+            x, params["lm_head"].astype(cfg.dtype), toks[:, 1:],
+            cfg.ce_block, "dv") / toks[:, 1:].size
     logits = llama_forward(params, toks[:, :-1], cfg, rules, mesh)
     return -jnp.mean(token_loglikes(logits, toks[:, 1:]))
 
