@@ -1475,6 +1475,12 @@ class CoreWorker:
         # observe an unowned id.  (No lineage entry: reconstruction of a
         # dynamic yield would re-run the whole generator — documented gap
         # vs the reference's lineage for dynamic returns.)
+        if entries[len(return_ids):] and return_ids \
+                and return_ids[0].hex() not in self.owned:
+            # Caller freed the generator ref before the reply arrived:
+            # adopting the per-yield extras now would leave them owned
+            # with no reachable ref (permanent leak).  Drop them instead.
+            entries = entries[:len(return_ids)]
         for oid_hex, kind, data in entries[len(return_ids):]:
             self.owned.add(oid_hex)
             if kind == "inline":

@@ -61,7 +61,7 @@ class LocalModeCore:
         try:
             result = func(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 - stored, raised at get()
-            return [self._ref_for(e, is_error=True)]
+            return self._error_refs(e, num_returns)
         if num_returns == "dynamic":
             return [self._ref_for(ObjectRefGenerator(
                 [self._ref_for(v) for v in result]))]
@@ -86,14 +86,22 @@ class LocalModeCore:
             self._named[(namespace, name)] = aid
         return aid
 
+    def _error_refs(self, exc, num_returns) -> List[ObjectRef]:
+        """Mirror cluster-mode arity: `a, b = f.remote()` must unpack at
+        submission and surface the error at get() — n DISTINCT refs (same
+        identity semantics as cluster return ids), each holding the
+        exception."""
+        n = 1 if num_returns == "dynamic" else num_returns
+        return [self._ref_for(exc, is_error=True) for _ in range(n)]
+
     def submit_actor_task(self, actor_id_hex, method, args, kwargs, *,
                           num_returns=1, **_) -> List[ObjectRef]:
         inst = self._actors.get(actor_id_hex)
         if inst is None:
             from ray_tpu import exceptions as rex
-            return [self._ref_for(
+            return self._error_refs(
                 rex.ActorDiedError(f"actor {actor_id_hex[:12]} is dead"),
-                is_error=True)]
+                num_returns)
         bound = getattr(inst, method)
         return self.submit_task(bound, args, kwargs,
                                 num_returns=num_returns)

@@ -51,9 +51,11 @@ class ActorMethod:
             return refs[0]
         return refs
 
-    def options(self, num_returns: int = 1, concurrency_group=None, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns,
-                           concurrency_group)
+    def options(self, num_returns=None, concurrency_group=None, **_):
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group or self._concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -63,24 +65,29 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id_hex: str, class_name: str = "Actor",
-                 _original: bool = False):
+                 _original: bool = False, _method_meta=None):
         self._actor_id_hex = actor_id_hex
         self._class_name = class_name
         # Only the handle returned by ActorClass.remote() owns the actor's
         # lifetime (reference: the original handle's out-of-scope kills a
         # non-detached actor; deserialized copies never do).
         self._original = _original
+        # {method_name: num_returns} from @ray_tpu.method decorators —
+        # return arity must be known caller-side at submission.
+        self._method_meta = _method_meta or {}
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        return ActorMethod(self, item,
+                           num_returns=self._method_meta.get(item, 1))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id_hex[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id_hex, self._class_name))
+        return (ActorHandle, (self._actor_id_hex, self._class_name,
+                              False, self._method_meta))
 
     def __del__(self):
         if not getattr(self, "_original", False):
@@ -133,8 +140,11 @@ class ActorClass:
         # Detached/named actors outlive their handles by design; anonymous
         # actors die with their original handle.
         original = opts["lifetime"] != "detached" and not opts["name"]
+        meta = {name: nr for name in dir(self._cls)
+                if (nr := getattr(getattr(self._cls, name, None),
+                                  "_rt_num_returns", None)) is not None}
         return ActorHandle(actor_id_hex, self._cls.__name__,
-                           _original=original)
+                           _original=original, _method_meta=meta)
 
 
 def exit_actor():

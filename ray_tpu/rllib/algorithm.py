@@ -118,26 +118,35 @@ class Algorithm(Trainable):
         policy = self.workers.local_worker.policy
         rewards, lens = [], []
         deadline = _time.monotonic() + timeout_s
-        for _ in range(num_episodes):
-            if _time.monotonic() > deadline:
-                break
-            if hasattr(policy, "_ensure_state"):
-                policy._ensure_state(1)
-                policy.notify_dones(_np.array([True]))
-            obs = env.vector_reset()
-            total, steps = 0.0, 0
-            for _ in range(cfg.get("evaluation_max_steps", 1000)):
-                out = policy.compute_actions(
-                    _np.asarray(obs, _np.float32))
-                obs, rew, done, _info = env.vector_step(out["actions"])
-                total += float(rew[0])
-                steps += 1
-                if hasattr(policy, "notify_dones"):
-                    policy.notify_dones(done)
-                if bool(done[0]):
+        # Recurrent policies carry rollout state on the policy object;
+        # with local sampling that state is mid-episode training state —
+        # snapshot it and restore after evaluation so eval never perturbs
+        # training (ADVICE r4).
+        saved_state = getattr(policy, "_state", None)
+        try:
+            for _ in range(num_episodes):
+                if _time.monotonic() > deadline:
                     break
-            rewards.append(total)
-            lens.append(steps)
+                if hasattr(policy, "_ensure_state"):
+                    policy._state = None
+                    policy._ensure_state(1)
+                obs = env.vector_reset()
+                total, steps = 0.0, 0
+                for _ in range(cfg.get("evaluation_max_steps", 1000)):
+                    out = policy.compute_actions(
+                        _np.asarray(obs, _np.float32))
+                    obs, rew, done, _info = env.vector_step(out["actions"])
+                    total += float(rew[0])
+                    steps += 1
+                    if hasattr(policy, "notify_dones"):
+                        policy.notify_dones(done)
+                    if bool(done[0]):
+                        break
+                rewards.append(total)
+                lens.append(steps)
+        finally:
+            if hasattr(policy, "_ensure_state"):
+                policy._state = saved_state
         return {
             "evaluation": {
                 "episode_reward_mean": float(_np.mean(rewards))

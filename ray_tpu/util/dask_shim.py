@@ -17,13 +17,20 @@ from typing import Any, Dict, Hashable, List
 import ray_tpu
 
 
-def _is_key(graph: Dict, x: Any) -> bool:
-    return isinstance(x, Hashable) and not isinstance(x, tuple) \
-        and x in graph
-
-
 def _is_task(x: Any) -> bool:
     return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def _is_key(graph: Dict, x: Any) -> bool:
+    """dask.core semantics: a tuple is a task iff tuple[0] is callable;
+    any other hashable present in the graph is a key — including the
+    ``(name, index)`` tuple keys real dask collections use."""
+    if _is_task(x) or not isinstance(x, Hashable):
+        return False
+    try:
+        return x in graph
+    except TypeError:   # e.g. tuple containing a list
+        return False
 
 
 def _exec_spec(fn, *resolved):
@@ -45,14 +52,15 @@ def ray_dask_get(graph: Dict, keys, **kwargs):
     refs: Dict[Any, Any] = {}
 
     def resolve(x):
-        """Literal | key | (fn, ...) | [list] -> value-or-ref."""
-        if _is_key(graph, x):
-            return materialize(x)
+        """Literal | key | (fn, ...) | [list] -> value-or-ref.  Task
+        check precedes key check, mirroring dask.core._execute_task."""
         if _is_task(x):
             # Inline (anonymous nested) task: dask nests these inside
             # specs; compute eagerly as its own cluster task.
             fn, *args = x
             return exec_task.remote(fn, *[resolve(a) for a in args])
+        if _is_key(graph, x):
+            return materialize(x)
         if isinstance(x, list):
             resolved = [resolve(a) for a in x]
             if any(isinstance(r, ray_tpu.ObjectRef) for r in resolved):

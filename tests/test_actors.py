@@ -236,3 +236,30 @@ def test_concurrency_groups_isolate_slots(ray_start):
         ray_tpu.get(w.quick.options(concurrency_group="nope").remote(),
                     timeout=10)
     ray_tpu.get(blockers, timeout=30)
+
+
+def test_method_num_returns_decorator(ray_start):
+    """@ray_tpu.method(num_returns=2) must yield two refs from the plain
+    handle call — not one ref holding the tuple (ADVICE r4).  Metadata
+    survives handle serialization (pass-to-task)."""
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "a", "b"
+
+        def single(self):
+            return "s"
+
+    s = Splitter.remote()
+    a, b = s.pair.remote()
+    assert ray_tpu.get(a, timeout=60) == "a"
+    assert ray_tpu.get(b, timeout=30) == "b"
+    assert ray_tpu.get(s.single.remote(), timeout=30) == "s"
+
+    @ray_tpu.remote
+    def via_task(handle):
+        x, y = handle.pair.remote()
+        return ray_tpu.get(x), ray_tpu.get(y)
+
+    assert ray_tpu.get(via_task.remote(s), timeout=60) == ("a", "b")

@@ -232,5 +232,14 @@ def test_local_mode_inline_execution():
         assert rt.get(c2.inc.remote()) == 2
         ready, rest = rt.wait([rt.put(1), rt.put(2)])
         assert len(ready) == 1 and len(rest) == 1
+
+        @rt.remote(num_returns=2)
+        def boom2():
+            raise ValueError("boom2")
+
+        a, b = boom2.remote()   # must unpack, same as cluster mode
+        for r in (a, b):
+            with pytest.raises(ValueError, match="boom2"):
+                rt.get(r)
     finally:
         rt.shutdown()

@@ -48,3 +48,17 @@ def test_intermediates_stay_remote(ray_start):
     }
     l, r = ray_dask_get(graph, ["l", "r"])
     assert (l, r) == (60, 106)
+
+
+def test_tuple_keys_like_dask_collections(ray_start):
+    """Real dask collections key blocks as (name, index) tuples; a tuple
+    referenced in a spec must resolve as a key, not pass through as a
+    literal (ADVICE r4)."""
+    import operator
+    graph = {
+        ("x", 0): 10,
+        ("x", 1): (operator.add, ("x", 0), 5),
+        ("sum", 0): (operator.add, ("x", 1), ("x", 0)),
+    }
+    assert ray_dask_get(graph, ("sum", 0)) == 25
+    assert ray_dask_get(graph, [[("x", 1), ("sum", 0)]]) == [[15, 25]]
