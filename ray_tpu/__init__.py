@@ -114,7 +114,8 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         name, namespace or global_worker.namespace)
     if info is None:
         raise ValueError(f"no live actor named '{name}'")
-    return ActorHandle(info["actor_id"], name)
+    return ActorHandle(info["actor_id"], name,
+                       _method_meta=info.get("method_meta") or {})
 
 
 def cluster_resources() -> Dict[str, float]:

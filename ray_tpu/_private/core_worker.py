@@ -1502,7 +1502,8 @@ class CoreWorker:
                                     name=None, namespace="default",
                                     get_if_exists=False, detached=False,
                                     max_concurrency=1, scheduling=None,
-                                    concurrency_groups=None):
+                                    concurrency_groups=None,
+                                    method_meta=None):
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
         creation_spec = cloudpickle.dumps({
             "cls": cloudpickle.dumps(cls),
@@ -1524,6 +1525,7 @@ class CoreWorker:
             "detached": detached,
             "get_if_exists": get_if_exists,
             "scheduling": scheduling or {},
+            "method_meta": dict(method_meta or {}),
         }, pinned_args
 
     async def create_actor_async(self, cls, args, kwargs, **opts) -> str:
@@ -1546,13 +1548,14 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, resources=None,
                      max_restarts=0, name=None, namespace="default",
                      get_if_exists=False, detached=False, max_concurrency=1,
-                     concurrency_groups=None, scheduling=None) -> str:
+                     concurrency_groups=None, scheduling=None,
+                     method_meta=None) -> str:
         req, pinned_args = self._build_create_actor_request(
             cls, args, kwargs, resources=resources,
             max_restarts=max_restarts, name=name, namespace=namespace,
             get_if_exists=get_if_exists, detached=detached,
             max_concurrency=max_concurrency, scheduling=scheduling,
-            concurrency_groups=concurrency_groups)
+            concurrency_groups=concurrency_groups, method_meta=method_meta)
         reply = self._run(self.gcs.request(req))
         self._pin_actor_creation(reply["actor_id"], pinned_args)
         return reply["actor_id"]

@@ -94,6 +94,9 @@ class ActorInfo:
     detached: bool = False
     death_cause: Optional[str] = None
     scheduling: dict = field(default_factory=dict)
+    # {method_name: num_returns} from @ray_tpu.method decorators; served
+    # with get_named_actor so get_actor() handles honor return arity.
+    method_meta: dict = field(default_factory=dict)
     waiters: List[asyncio.Future] = field(default_factory=list)
     creation_attempts: int = 0  # spawn-failure retries (not user restarts)
 
@@ -101,6 +104,7 @@ class ActorInfo:
         return {
             "actor_id": self.actor_id.hex(),
             "name": self.name,
+            "method_meta": self.method_meta,
             "state": self.state,
             "address": self.address,
             "node_id": self.node_id.hex() if self.node_id else None,
@@ -555,6 +559,7 @@ class GcsServer:
             owner_job=msg.get("job_id"),
             detached=msg.get("detached", False),
             scheduling=msg.get("scheduling", {}),
+            method_meta=msg.get("method_meta") or {},
         )
         self.actors[actor_id] = actor
         logger.debug("create_actor %s: scheduling", actor_id)

@@ -24,6 +24,7 @@ class LocalModeCore:
         self._store: Dict[str, Any] = {}       # hex -> ("val"|"err", value)
         self._actors: Dict[str, Any] = {}      # actor_id hex -> instance
         self._named: Dict[tuple, str] = {}     # (ns, name) -> actor_id
+        self._method_meta: Dict[str, dict] = {}  # actor_id -> {meth: n_ret}
         self.address = "local"
         self.node_id_hex = "local0" * 4 + "beef"
         self.job_id = "local"
@@ -75,13 +76,15 @@ class LocalModeCore:
 
     # -- actors -----------------------------------------------------------
     def create_actor(self, cls, args, kwargs, *, name=None,
-                     namespace="default", get_if_exists=False, **_) -> str:
+                     namespace="default", get_if_exists=False,
+                     method_meta=None, **_) -> str:
         if name and (namespace, name) in self._named:
             if get_if_exists:
                 return self._named[(namespace, name)]
             raise ValueError(f"actor name {name!r} already taken")
         aid = ActorID.from_random().hex()
         self._actors[aid] = cls(*args, **kwargs)
+        self._method_meta[aid] = dict(method_meta or {})
         if name:
             self._named[(namespace, name)] = aid
         return aid
@@ -117,7 +120,10 @@ class LocalModeCore:
 
     def get_named_actor(self, name: str, namespace: str = "default"):
         aid = self._named.get((namespace, name))
-        return {"actor_id": aid, "class_name": "Actor"} if aid else None
+        if not aid:
+            return None
+        return {"actor_id": aid, "class_name": "Actor",
+                "method_meta": self._method_meta.get(aid, {})}
 
     # -- misc surface used by utilities -----------------------------------
     def cluster_resources(self) -> Dict[str, float]:

@@ -125,8 +125,12 @@ class ActorClass:
         opts = self._options
         from ray_tpu._private.worker import global_worker
         namespace = opts["namespace"] or global_worker.namespace
+        meta = {name: nr for name in dir(self._cls)
+                if (nr := getattr(getattr(self._cls, name, None),
+                                  "_rt_num_returns", None)) is not None}
         actor_id_hex = core.create_actor(
             self._cls, args, kwargs,
+            method_meta=meta,
             resources=_build_resources(opts),
             max_restarts=opts["max_restarts"],
             name=opts["name"],
@@ -140,9 +144,6 @@ class ActorClass:
         # Detached/named actors outlive their handles by design; anonymous
         # actors die with their original handle.
         original = opts["lifetime"] != "detached" and not opts["name"]
-        meta = {name: nr for name in dir(self._cls)
-                if (nr := getattr(getattr(self._cls, name, None),
-                                  "_rt_num_returns", None)) is not None}
         return ActorHandle(actor_id_hex, self._cls.__name__,
                            _original=original, _method_meta=meta)
 

@@ -114,7 +114,11 @@ class ImpalaPolicy(Policy):
         vf_coeff = config.get("vf_loss_coeff", 0.5)
         ent_coeff = config.get("entropy_coeff", 0.01)
 
-        @jax.jit
+        # Multi-device learner: V-trace update shard_mapped over a ("dp",)
+        # mesh, batch (B) sharded, grads pmean'd (see rllib/learner.py).
+        self._n_learn = int(config.get("num_learner_devices", 1) or 1)
+        axis = "dp" if self._n_learn > 1 else None
+
         def _update(params, opt_state, batch):
             B, T = batch[REWARDS].shape
             flat_obs = batch[OBS].reshape((B * T,) + batch[OBS].shape[2:])
@@ -139,11 +143,20 @@ class ImpalaPolicy(Policy):
 
             (_, stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if axis is not None:
+                grads = jax.lax.pmean(grads, axis)
+                stats = jax.lax.pmean(stats, axis)
             import optax as _ox
             updates, opt_state = self._tx.update(grads, opt_state)
             params = _ox.apply_updates(params, updates)
             return params, opt_state, stats
-        self._update = _update
+
+        if axis is not None:
+            from ray_tpu.rllib.learner import learner_mesh, shard_update
+            self._mesh = learner_mesh(self._n_learn)
+            self._update = shard_update(_update, self._mesh)
+        else:
+            self._update = jax.jit(_update)
 
     def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
         self._rng, rng = jax.random.split(self._rng)
@@ -152,6 +165,9 @@ class ImpalaPolicy(Policy):
 
     def learn_on_batch(self, batch) -> Dict[str, float]:
         """batch is already device-resident (the loader thread put it)."""
+        if self._n_learn > 1:
+            from ray_tpu.rllib.learner import trim_batch
+            batch = trim_batch(batch, self._n_learn)
         self.params, self.opt_state, stats = self._update(
             self.params, self.opt_state, batch)
         return {k: float(v) for k, v in stats.items()}

@@ -233,12 +233,22 @@ class PPOPolicy(Policy):
 
         num_epochs = config.get("num_sgd_iter", 4)
         mb_size = config.get("sgd_minibatch_size", 128)
+        # Multi-device learner (reference: multi_gpu_learner_thread.py):
+        # the SAME update program shard_maps over a ("dp",) mesh — each
+        # device SGDs on its batch shard, grads pmean over the axis per
+        # minibatch step, params stay replicated bit-identically.
+        self._n_learn = int(config.get("num_learner_devices", 1) or 1)
+        axis = "dp" if self._n_learn > 1 else None
 
-        @jax.jit
         def _update(params, opt_state, rng, batch):
-            n = batch[OBS].shape[0]
-            mb = min(mb_size, n)  # small batches become one minibatch
+            n = batch[OBS].shape[0]   # LOCAL rows under shard_map
+            # sgd_minibatch_size is GLOBAL: each device takes its 1/N
+            # slice so step count and effective batch match dp=1.
+            mb = min(max(1, mb_size // self._n_learn), n)
             num_mb = n // mb
+            if axis is not None:
+                # decorrelate shard-local shuffles across devices
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
             def epoch_body(carry, epoch_rng):
                 params, opt_state = carry
@@ -252,6 +262,9 @@ class PPOPolicy(Policy):
                     params, opt_state = carry
                     (_, stats), grads = jax.value_and_grad(
                         _loss, has_aux=True)(params, mb)
+                    if axis is not None:
+                        grads = jax.lax.pmean(grads, axis)
+                        stats = jax.lax.pmean(stats, axis)
                     updates, opt_state = self._tx.update(grads, opt_state)
                     params = optax.apply_updates(params, updates)
                     return (params, opt_state), stats
@@ -265,7 +278,13 @@ class PPOPolicy(Policy):
                 epoch_body, (params, opt_state), epoch_rngs)
             last_stats = jax.tree.map(lambda s: s[-1, -1], stats)
             return params, opt_state, last_stats
-        self._update = _update
+
+        if axis is not None:
+            from ray_tpu.rllib.learner import learner_mesh, shard_update
+            self._mesh = learner_mesh(self._n_learn)
+            self._update = shard_update(_update, self._mesh)
+        else:
+            self._update = jax.jit(_update)
 
     # -- rollout side -----------------------------------------------------
     def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
@@ -284,6 +303,9 @@ class PPOPolicy(Policy):
         adv = np.asarray(batch[ADVANTAGES], np.float32)
         batch = dict(batch)
         batch[ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        if self._n_learn > 1:
+            from ray_tpu.rllib.learner import trim_batch
+            batch = trim_batch(batch, self._n_learn)
         device_batch = {
             k: jnp.asarray(np.asarray(v, np.float32 if k != ACTIONS
                                       else None))

@@ -263,3 +263,11 @@ def test_method_num_returns_decorator(ray_start):
         return ray_tpu.get(x), ray_tpu.get(y)
 
     assert ray_tpu.get(via_task.remote(s), timeout=60) == ("a", "b")
+
+    # get_actor() handles must carry the metadata too (served by GCS)
+    named = Splitter.options(name="splitter-meta").remote()
+    ray_tpu.get(named.single.remote(), timeout=60)
+    h = ray_tpu.get_actor("splitter-meta")
+    x, y = h.pair.remote()
+    assert ray_tpu.get(x, timeout=30) == "a"
+    assert ray_tpu.get(y, timeout=30) == "b"
