@@ -222,7 +222,8 @@ class GcsServer:
                  "creation_spec": b64(a.creation_spec),
                  "resources": a.resources, "max_restarts": a.max_restarts,
                  "num_restarts": a.num_restarts, "detached": a.detached,
-                 "scheduling": a.scheduling}
+                 "scheduling": a.scheduling,
+                 "method_meta": a.method_meta}
                 # DEAD stays dead across restarts: a ray.kill'ed detached
                 # actor must not resurrect from the snapshot.
                 for a in self.actors.values()
@@ -278,7 +279,8 @@ class GcsServer:
                 resources=rec["resources"],
                 max_restarts=rec["max_restarts"],
                 num_restarts=rec["num_restarts"],
-                detached=True, scheduling=rec.get("scheduling", {}))
+                detached=True, scheduling=rec.get("scheduling", {}),
+                method_meta=rec.get("method_meta", {}))
             self.actors[actor.actor_id] = actor
             self._pending_actor_queue.append(actor.actor_id)
         for ns, name, aid in snap.get("named_actors", []):
@@ -438,7 +440,13 @@ class GcsServer:
         # registration* that never comes on a static cluster.  Fire and
         # forget: blocking the heartbeat reply on actor creation would
         # stall the raylet's heartbeat loop past the health timeout.
-        if self._pending_actor_queue:
+        if self._pending_actor_queue or any(
+                pg.state == "PENDING"
+                for pg in self.placement_groups.values()):
+            # PENDING PGs too: a PG created while the availability view
+            # was transiently empty (mid task-burst heartbeat) must retry
+            # when the next heartbeat shows capacity, not wait for a node
+            # registration that never comes on a static cluster.
             asyncio.get_running_loop().create_task(
                 self._try_schedule_pending())
         return {"ok": True}

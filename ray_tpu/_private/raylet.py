@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import collections
+import itertools
 import json
 import logging
 import os
@@ -129,7 +131,12 @@ class Raylet:
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         # env_key ("" = default) -> idle workers with that runtime env.
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}
-        self.pending_leases: List[LeaseRequest] = []
+        # Pending leases grouped by scheduling class (reference:
+        # scheduling_class in raylet's task queues): requests with the same
+        # (resources, pg, env) signature are interchangeable, so dispatch
+        # probes one head per class instead of scanning every request —
+        # O(classes) per completion, not O(queue).
+        self.pending_leases: Dict[tuple, collections.deque] = {}
         # lease_ids whose resources were returned early (worker blocked in
         # get); _h_return_lease must not return them a second time.
         self._blocked_leases: set = set()
@@ -155,6 +162,13 @@ class Raylet:
         self._spill_lock = asyncio.Lock()
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
+        # CPU-worker forkserver (lazy; see _private/forkserver.py): one
+        # warm template forked per worker instead of a cold interpreter.
+        from ray_tpu._private.forkserver import ForkserverClient
+        self._forkserver = ForkserverClient(
+            f"/tmp/rtfs-{node_id.hex()[:12]}.sock",
+            os.path.join(self.log_dir, "forkserver.log")) \
+            if os.environ.get("RT_DISABLE_FORKSERVER") != "1" else None
 
     def _num_idle(self) -> int:
         return sum(len(v) for v in self.idle_workers.values())
@@ -218,6 +232,8 @@ class Raylet:
                 w.proc.wait(timeout=3)
             except Exception:
                 w.proc.kill()
+        if self._forkserver is not None:
+            self._forkserver.close()
         await self.server.close()
         if self.gcs_conn:
             await self.gcs_conn.close()
@@ -314,19 +330,37 @@ class Raylet:
             "workers": workers,
         }
 
+    def _purge_dead_leases(self) -> None:
+        """Drop leases whose futures are done (caller cancelled / errored)
+        from anywhere in the class queues.  Dispatch only purges at class
+        heads it visits, so dead entries stuck behind a non-fitting head
+        would otherwise pin their args and inflate the demand report."""
+        for key in list(self.pending_leases.keys()):
+            dq = self.pending_leases.get(key)
+            if dq is None:
+                continue
+            live = [r for r in dq if not r.future.done()]
+            if len(live) != len(dq):
+                dq.clear()
+                dq.extend(live)
+            if not dq:
+                self.pending_leases.pop(key, None)
+
     async def _stuck_lease_watchdog(self):
         """Log scheduler state while leases sit queued — a queued lease
         with idle capacity means resource accounting has leaked."""
         while not self._shutdown:
             await asyncio.sleep(20)
+            self._purge_dead_leases()
             if self.pending_leases:
                 busy = sum(1 for w in self.workers.values() if w.busy)
                 logger.warning(
                     "raylet: %d leases pending; available=%s busy_workers=%d "
                     "idle=%d total_workers=%d wants=%s",
-                    len(self.pending_leases), self.resources_available,
+                    self._pending_len(), self.resources_available,
                     busy, self._num_idle(), len(self.workers),
-                    [r.resources for r in self.pending_leases[:4]])
+                    [r.resources for r in
+                     itertools.islice(self._pending_iter(), 4)])
 
     async def _heartbeat_loop(self):
         while not self._shutdown:
@@ -339,7 +373,8 @@ class Raylet:
                     # (reference: ray_syncer resource-load gossip feeding
                     # autoscaler LoadMetrics).
                     "pending_leases": [
-                        r.resources for r in self.pending_leases[:100]],
+                        r.resources for r in
+                        itertools.islice(self._pending_iter(), 100)],
                 })
             except Exception:
                 pass
@@ -478,6 +513,12 @@ class Raylet:
         env.update(self.worker_env)
         if runtime_env and runtime_env.get("env_vars"):
             env.update(runtime_env["env_vars"])
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # CPU-pinned workers must not register with a TPU pool at
+            # interpreter start (site hook keyed on PALLAS_AXON_POOL_IPS):
+            # the registration costs ~2s of the ~2.3s worker spawn and a
+            # spawn storm of pool registrations can wedge the TPU tunnel.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update({
             "RT_WORKER_ID": worker_id.hex(),
             "RT_NODE_ID": self.node_id.hex(),
@@ -496,18 +537,24 @@ class Raylet:
         wid8 = worker_id.hex()[:12]
         out_path = os.path.join(self.log_dir, f"worker-{wid8}.out")
         err_path = os.path.join(self.log_dir, f"worker-{wid8}.err")
-        out_f = open(out_path, "ab", buffering=0)
-        err_f = open(err_path, "ab", buffering=0)
-        try:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                env=env,
-                stdout=out_f,
-                stderr=err_f,
-            )
-        finally:
-            out_f.close()
-            err_f.close()
+        proc = None
+        if self._forkserver is not None and env.get("JAX_PLATFORMS") == "cpu":
+            # CPU workers fork from the warm template (~20ms, CoW pages);
+            # TPU workers need a cold interpreter for PJRT registration.
+            proc = self._forkserver.spawn(env, out_path, err_path)
+        if proc is None:
+            out_f = open(out_path, "ab", buffering=0)
+            err_f = open(err_path, "ab", buffering=0)
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    env=env,
+                    stdout=out_f,
+                    stderr=err_f,
+                )
+            finally:
+                out_f.close()
+                err_f.close()
         w = WorkerHandle(worker_id=worker_id, proc=proc, actor_id=actor_id,
                          env_key=env_key,
                          ready=asyncio.get_running_loop().create_future())
@@ -709,7 +756,7 @@ class Raylet:
                         raise RuntimeError(
                             f"bundle {req.bundle_index} of pg "
                             f"{req.pg_id[:16]} is not on this node")
-                self.pending_leases.append(req)
+                self._queue_lease(req)
                 asyncio.get_running_loop().create_task(
                     self._dispatch_leases())   # close the await-gap race
                 return await req.future
@@ -720,7 +767,7 @@ class Raylet:
                     from ray_tpu import exceptions as rex
                     raise rex.SchedulingError(
                         f"this node can never satisfy {req.resources}")
-                self.pending_leases.append(req)
+                self._queue_lease(req)
                 asyncio.get_running_loop().create_task(
                     self._dispatch_leases())   # close the await-gap race
                 return await req.future
@@ -746,7 +793,7 @@ class Raylet:
                 raise rex.SchedulingError(
                     f"no node in the cluster can ever satisfy "
                     f"{req.resources}")
-            self.pending_leases.append(req)
+            self._queue_lease(req)
             # Self-wake: resources may have freed during the awaits above
             # (a return_lease dispatching an empty queue would otherwise
             # never revisit this request).
@@ -870,33 +917,59 @@ class Raylet:
         self._blocked_leases.discard(w.lease_id)
         return {"ok": True}
 
+    def _lease_class(self, req: LeaseRequest) -> tuple:
+        return (tuple(sorted(req.resources.items())), req.pg_id,
+                req.bundle_index, req.env_key)
+
+    def _queue_lease(self, req: LeaseRequest) -> None:
+        self.pending_leases.setdefault(
+            self._lease_class(req), collections.deque()).append(req)
+
+    def _pending_iter(self):
+        for dq in self.pending_leases.values():
+            yield from dq
+
+    def _pending_len(self) -> int:
+        return sum(len(dq) for dq in self.pending_leases.values())
+
     async def _dispatch_leases(self):
-        """Grant queued leases that fit now.  A request is REMOVED from the
+        """Grant queued leases that fit now.  A request is REMOVED from its
         queue before any await: _grant suspends for worker spawn (~1.5s),
         and a second dispatcher started meanwhile (return_lease /
-        reserve_bundle / heartbeat all trigger one) iterating the same list
-        would double-deduct resources for the same lease and strand a
-        worker (its grant dropped at the future.done() check)."""
-        i = 0
-        while i < len(self.pending_leases):
-            req = self.pending_leases[i]
-            if req.future.done():
-                self.pending_leases.pop(i)
-                continue
-            if not self._fits(req):
-                i += 1
-                continue
-            self.pending_leases.pop(i)   # claim before awaiting
-            try:
-                grant = await self._grant(req)
-            except Exception as e:
-                if not req.future.done():
-                    req.future.set_exception(e)
-                continue
-            if not req.future.done():
-                req.future.set_result(grant)
-            # restart: the grant's awaits may have changed the queue
-            i = 0
+        reserve_bundle / heartbeat all trigger one) iterating the same
+        queues would double-deduct resources for the same lease and strand
+        a worker (its grant dropped at the future.done() check).
+
+        Requests within a class are interchangeable, so a non-fitting head
+        disqualifies its whole class — each pass costs O(classes + grants),
+        which keeps a 10k-deep backlog linear instead of quadratic."""
+        progress = True
+        while progress:
+            progress = False
+            for key in list(self.pending_leases.keys()):
+                dq = self.pending_leases.get(key)
+                while dq:
+                    req = dq[0]
+                    if req.future.done():
+                        dq.popleft()
+                        continue
+                    if not self._fits(req):
+                        break
+                    dq.popleft()   # claim before awaiting
+                    try:
+                        grant = await self._grant(req)
+                    except Exception as e:
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                        progress = True
+                        continue
+                    if not req.future.done():
+                        req.future.set_result(grant)
+                    # the grant's awaits may have freed/claimed resources
+                    progress = True
+                    dq = self.pending_leases.get(key)  # re-read post-await
+                if not self.pending_leases.get(key):
+                    self.pending_leases.pop(key, None)
 
     # -- object spilling (reference raylet/local_object_manager.h:41) --
 
@@ -1299,7 +1372,7 @@ class Raylet:
             "node_id": self.node_id.hex(),
             "num_workers": len(self.workers),
             "num_idle": self._num_idle(),
-            "pending_leases": len(self.pending_leases),
+            "pending_leases": self._pending_len(),
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "plasma": self.plasma.stats(),

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import builtins
 import random as _random
+import time
 import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
@@ -399,6 +400,37 @@ def _fused_stages(stages, block):
     return block
 
 
+def _safe_rows(block) -> int:
+    try:
+        return BlockAccessor(block).num_rows()
+    except Exception:
+        return 0
+
+
+def _stage_label(kernel, fn) -> str:
+    k = kernel.__name__.lstrip("_").replace("_block", "").replace(
+        "_rows", "")
+    f = getattr(fn, "__name__", type(fn).__name__)
+    return f"{k}({f})" if f != "<lambda>" else k
+
+
+def _fused_stages_stats(stages, block):
+    """`_fused_stages` plus per-stage wall/row accounting (reference:
+    data/_internal/stats.py:1 — StatsActor collects per-stage metrics;
+    here each fused task returns its measurements as a second return, so
+    stats ride the existing task replies with no extra RPC)."""
+    stats = []
+    for kernel, fn, extra in stages:
+        rows_in = _safe_rows(block)
+        t0 = time.perf_counter()
+        block = kernel(fn, block, *extra)
+        stats.append({"stage": _stage_label(kernel, fn),
+                      "wall_s": time.perf_counter() - t0,
+                      "rows_in": rows_in,
+                      "rows_out": _safe_rows(block)})
+    return block, stats
+
+
 class ActorPoolStrategy:
     """compute= strategy running stages on a pool of reusable actors
     (reference _internal/compute.py:179 -- min_size/max_size bounds; the
@@ -454,6 +486,11 @@ class Dataset:
         self._executed: Optional[List[Any]] = \
             None if self._stages else self._input_blocks
         self._metadata = metadata if not self._stages else None
+        # Execution stats trail (reference data/_internal/stats.py):
+        # ordered ("fused", [per-block stats refs]) and ("barrier", rec)
+        # entries, inherited from ancestor datasets so a map -> shuffle ->
+        # map chain reports every stage in execution order.
+        self._stats_trail: List[tuple] = []
 
     @property
     def _blocks(self) -> List[Any]:
@@ -461,10 +498,13 @@ class Dataset:
 
     def _execute(self) -> List[Any]:
         if self._executed is None:
-            task = ray_tpu.remote(_fused_stages)
+            task = ray_tpu.remote(_fused_stages_stats).options(
+                num_returns=2)
             stages = list(self._stages)
-            self._executed = [task.remote(stages, b)
-                              for b in self._input_blocks]
+            out = [task.remote(stages, b) for b in self._input_blocks]
+            self._executed = [r[0] for r in out]
+            if stages:
+                self._stats_trail.append(("fused", [r[1] for r in out]))
         return self._executed
 
     # -- introspection ----------------------------------------------------
@@ -491,10 +531,40 @@ class Dataset:
                 [meta_task.remote(b) for b in self._blocks])
         return self._metadata
 
-    def stats(self) -> Dict[str, Any]:
-        return {"num_blocks": self.num_blocks(),
-                "num_rows": self.count(),
-                "size_bytes": self.size_bytes()}
+    def stats(self) -> str:
+        """Per-stage execution breakdown (reference:
+        ``python/ray/data/_internal/stats.py:1`` — ``ds.stats()`` returns a
+        formatted per-stage wall/row report).  Executes the plan if it has
+        not run yet.  Barrier ops (shuffle/sort/repartition) report their
+        driver-measured wall time; fused map stages report per-block
+        min/mean/max task time and row in/out totals."""
+        self._execute()
+        lines = [f"Dataset: {self.num_blocks()} blocks, "
+                 f"{self.count()} rows, {self.size_bytes()} bytes"]
+        for kind, payload in self._stats_trail:
+            if kind == "barrier":
+                lines.append(
+                    f"Stage [{payload['stage']}]: "
+                    f"{payload.get('blocks', '?')} blocks, "
+                    f"{payload['wall_s'] * 1000:.1f}ms submit (barrier)")
+                continue
+            per_block = ray_tpu.get(list(payload))
+            by_stage: Dict[int, List[dict]] = {}
+            for task_stats in per_block:
+                for i, s in enumerate(task_stats):
+                    by_stage.setdefault(i, []).append(s)
+            for i in sorted(by_stage):
+                ss = by_stage[i]
+                walls = [s["wall_s"] for s in ss]
+                lines.append(
+                    f"Stage [{ss[0]['stage']}]: {len(ss)} blocks, "
+                    f"{sum(walls) * 1000:.1f}ms total, "
+                    f"{min(walls) * 1000:.2f}/"
+                    f"{sum(walls) / len(walls) * 1000:.2f}/"
+                    f"{max(walls) * 1000:.2f}ms min/mean/max per block, "
+                    f"rows {sum(s['rows_in'] for s in ss)} -> "
+                    f"{sum(s['rows_out'] for s in ss)}")
+        return "\n".join(lines)
 
     # -- transforms -------------------------------------------------------
     def _run_stage(self, kernel, fn, compute=None, extra=(),
@@ -524,12 +594,15 @@ class Dataset:
                         for i, b in enumerate(blocks)]
             out = Dataset(refs)
             out._actor_pool = pool  # keep alive until ds collected
+            out._stats_trail = list(self._stats_trail)
             return out
         # Lazy: append to the plan; fused at execution time.
-        return Dataset(self._input_blocks if self._executed is None
-                       else self._executed,
-                       stages=(self._stages if self._executed is None
-                               else []) + [(kernel, fn, tuple(extra))])
+        out = Dataset(self._input_blocks if self._executed is None
+                      else self._executed,
+                      stages=(self._stages if self._executed is None
+                              else []) + [(kernel, fn, tuple(extra))])
+        out._stats_trail = list(self._stats_trail)
+        return out
 
     def map(self, fn: Callable, *, compute=None) -> "Dataset":
         return self._run_stage(_map_rows_block, fn, compute)
@@ -607,11 +680,12 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         """Rebalance rows into exactly num_blocks blocks (reference
         dataset.py:928)."""
-        total = self.count()
+        total = self.count()   # executes upstream; not this barrier's time
+        t0 = time.perf_counter()
         sizes = [total // num_blocks +
                  (1 if i < total % num_blocks else 0)
                  for i in builtins.range(num_blocks)]
-        return self._rechunk(sizes)
+        return self._note_barrier(self._rechunk(sizes), "repartition", t0)
 
     def split(self, n: int, *, equal: bool = False,
               locality_hints=None) -> List["Dataset"]:
@@ -637,12 +711,14 @@ class Dataset:
         """
         n = max(1, len(self._blocks))
         base_seed = seed if seed is not None else _random.randrange(2**31)
+        t0 = time.perf_counter()
         if push_based is None:
             push_based = n >= 8
         if push_based and n > 1:
             from ray_tpu.data.push_shuffle import push_based_shuffle
-            return Dataset(push_based_shuffle(list(self._blocks),
-                                              seed=base_seed))
+            out = Dataset(push_based_shuffle(list(self._blocks),
+                                             seed=base_seed))
+            return self._note_barrier(out, "push_based_shuffle", t0)
         part_task = ray_tpu.remote(_shuffle_partition)
         merge_task = ray_tpu.remote(_shuffle_merge)
         parts = [
@@ -655,16 +731,29 @@ class Dataset:
                                   *[parts[i][j]
                                     for i in builtins.range(len(parts))])
                 for j in builtins.range(n)]
-        return Dataset(refs)
+        return self._note_barrier(Dataset(refs), "random_shuffle", t0)
+
+    def _note_barrier(self, out: "Dataset", name: str,
+                      t0: float) -> "Dataset":
+        """Record a barrier op on the result's stats trail (driver-side
+        submit wall; the per-task time shows up in downstream stages)."""
+        out._stats_trail = self._stats_trail + [
+            ("barrier", {"stage": name,
+                         "wall_s": time.perf_counter() - t0,
+                         "blocks": len(out._input_blocks)})]
+        return out
 
     def sort(self, key: Union[str, Callable, None] = None,
              descending: bool = False) -> "Dataset":
         """Per-block sort + n-way streaming merge into one block."""
+        blocks = self._blocks   # executes upstream; not this barrier's time
+        t0 = time.perf_counter()
         sort_task = ray_tpu.remote(_sort_block)
         merge_task = ray_tpu.remote(_merge_sorted)
         sorted_refs = [sort_task.remote(b, key, descending)
-                       for b in self._blocks]
-        return Dataset([merge_task.remote(key, descending, *sorted_refs)])
+                       for b in blocks]
+        out = Dataset([merge_task.remote(key, descending, *sorted_refs)])
+        return self._note_barrier(out, "sort", t0)
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
         """Split at global row indices (reference:
@@ -831,18 +920,25 @@ class Dataset:
             return
         import itertools as _it
         from collections import deque
-        task = ray_tpu.remote(_fused_stages)
+        task = ray_tpu.remote(_fused_stages_stats).options(num_returns=2)
         stages = list(self._stages)
+        stats_refs: List[Any] = []
+
+        def submit(b):
+            block_ref, stats_ref = task.remote(stages, b)
+            stats_refs.append(stats_ref)
+            return block_ref
+
         pending: "deque" = deque()
         done: List[Any] = []
         inputs = iter(self._input_blocks)
         for b in _it.islice(inputs, max(1, window)):
-            pending.append(task.remote(stages, b))
+            pending.append(submit(b))
         for b in inputs:
             ref = pending.popleft()
             done.append(ref)
             yield ref
-            pending.append(task.remote(stages, b))
+            pending.append(submit(b))
         while pending:
             ref = pending.popleft()
             done.append(ref)
@@ -850,6 +946,8 @@ class Dataset:
         # Fully drained: cache so later iterations / _blocks consumers
         # reuse the results instead of re-running the whole pipeline.
         self._executed = done
+        if stages:
+            self._stats_trail.append(("fused", stats_refs))
 
     def _iter_resolved_blocks(self, prefetch_blocks: int) -> Iterator[Any]:
         """Yield materialized blocks through the streaming executor,

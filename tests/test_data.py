@@ -369,3 +369,24 @@ def test_push_shuffle_preserves_block_count_when_mergers_capped(ray_start):
         out = ds.random_shuffle(seed=3)   # mergers capped at 4
     assert out.num_blocks() == 12
     assert sorted(out.take_all()) == list(range(60))
+
+
+def test_dataset_stats_fused_pipeline(ray_start):
+    """ds.stats() reports per-stage wall/rows for a fused multi-stage
+    pipeline plus barrier records (reference: data/_internal/stats.py)."""
+    import ray_tpu.data as rd
+    ds = (rd.range(200, parallelism=4)
+          .map(lambda x: x + 1)
+          .filter(lambda x: x % 2 == 0)
+          .random_shuffle(seed=0, push_based=True)
+          .map(lambda x: x * 2))
+    assert ds.count() == 100
+    s = ds.stats()
+    assert "push_based_shuffle" in s
+    assert "map" in s and "filter" not in s.split("map")[0]
+    # the final map stage ran on the shuffled blocks: rows 100 -> 100
+    assert "rows 100 -> 100" in s
+    # a streaming-executor consumption also collects stats
+    ds2 = rd.range(100, parallelism=5).map(lambda x: x + 1)
+    list(ds2.iter_batches(batch_size=50))
+    assert "blocks" in ds2.stats()
