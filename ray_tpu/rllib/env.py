@@ -272,6 +272,9 @@ def register_env(name: str, cls) -> None:
 def make_vector_env(name: str, num_envs: int, seed: int = 0,
                     **kwargs) -> VectorEnv:
     if name not in _ENV_REGISTRY:
+        # Built-in extras register on first use (the pixel suite).
+        import ray_tpu.rllib.pixel_env  # noqa: F401
+    if name not in _ENV_REGISTRY:
         raise KeyError(
             f"unknown env {name!r}; registered: {sorted(_ENV_REGISTRY)}")
     return _ENV_REGISTRY[name](num_envs=num_envs, seed=seed, **kwargs)

@@ -56,7 +56,7 @@ def ac_init(rng: jax.Array, obs_dim: int, num_outputs: int,
 
 def head_forward(params: Dict, obs: jax.Array) -> jax.Array:
     """Trunk + pi head only (Q-values for DQN-style policies)."""
-    x = obs
+    x = _flatten_obs(obs)
     i = 0
     while f"trunk{i}" in params:
         p = params[f"trunk{i}"]
@@ -65,9 +65,15 @@ def head_forward(params: Dict, obs: jax.Array) -> jax.Array:
     return x @ params["pi"]["w"] + params["pi"]["b"]
 
 
+def _flatten_obs(obs: jax.Array) -> jax.Array:
+    """Image observations (e.g. the 10x10xC MinAtar-class envs) flatten at
+    the network boundary; vector obs pass through."""
+    return obs.reshape(obs.shape[0], -1) if obs.ndim > 2 else obs
+
+
 def ac_forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """-> (pi_out [B, num_outputs], value [B])."""
-    x = obs
+    x = _flatten_obs(obs)
     i = 0
     while f"trunk{i}" in params:
         p = params[f"trunk{i}"]

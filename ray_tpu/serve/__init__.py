@@ -16,7 +16,7 @@ view refreshed from the controller.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.serve.batching import batch  # noqa: F401
@@ -66,6 +66,8 @@ class Deployment:
                 "num_cpus", 1.0),
             autoscaling=self.config.get("autoscaling_config"),
             user_config=self.config.get("user_config"),
+            runtime_env=self.config.get("ray_actor_options", {}).get(
+                "runtime_env"),
         )
 
 
@@ -171,24 +173,74 @@ def delete(name: str):
     ray_tpu.get(_controller().delete_deployment.remote(name))
 
 
-def start_http(host: str = "127.0.0.1", port: int = 0) -> str:
-    """Start the HTTP ingress actor; returns its base URL
-    (reference: HTTPProxyActor, http_proxy.py:387)."""
-    from ray_tpu._private.worker import global_worker
+def start_http(host: str = "127.0.0.1", port: int = 0,
+               per_node: bool = False) -> str:
+    """Start the HTTP ingress; returns the first ingress's base URL.
+
+    Reference: one ``HTTPProxyActor`` per node (http_proxy.py:387) so no
+    single actor is a serving bottleneck or SPOF.  ``per_node=True``
+    starts one ingress pinned to every alive node (named
+    ``_serve_http:<node12>``); ``http_addresses()`` lists them all.  Each
+    ingress keeps its own long-poll-refreshed route table, so any of them
+    can serve any route."""
+    urls = _start_ingresses(host, port, per_node)
+    return urls[0]
+
+
+def http_addresses() -> List[str]:
+    """Base URLs of every running ingress actor (reference:
+    serve.status() proxy listing)."""
+    from ray_tpu._private.worker import get_core
+    urls = []
+    named = get_core().gcs_request({"type": "list_named_actors"})
+    for rec in named:
+        name = rec["name"]
+        if name == "_serve_http" or name.startswith("_serve_http:"):
+            try:
+                a = ray_tpu.get_actor(name)
+                h, p = ray_tpu.get(a.address.remote(), timeout=30)
+                urls.append(f"http://{h}:{p}")
+            except Exception:
+                pass
+    return sorted(urls)
+
+
+def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
+    from ray_tpu._private.worker import get_core, global_worker
     from ray_tpu.serve.http_ingress import HTTPIngress
     _controller()  # make sure the controller exists for route refresh
     ingress_cls = ray_tpu.remote(HTTPIngress)
-    ingress = ingress_cls.options(name="_serve_http", lifetime="detached",
-                                  get_if_exists=True, num_cpus=0.1,
-                                  max_concurrency=64).remote(
-        host, port, global_worker.namespace)
-    addr = ray_tpu.get(ingress.address.remote())
-    return f"http://{addr[0]}:{addr[1]}"
+    targets: List[tuple] = [("_serve_http", None)]
+    if per_node:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        nodes = get_core().gcs_request({"type": "get_nodes"})
+        targets = [(f"_serve_http:{n['node_id'][:12]}",
+                    NodeAffinitySchedulingStrategy(n["node_id"]))
+                   for n in nodes if n["alive"]]
+    urls = []
+    for name, strategy in targets:
+        ingress = ingress_cls.options(
+            name=name, lifetime="detached", get_if_exists=True,
+            num_cpus=0, max_concurrency=64,
+            scheduling_strategy=strategy).remote(
+            host, port, global_worker.namespace)
+        addr = ray_tpu.get(ingress.address.remote(), timeout=60)
+        urls.append(f"http://{addr[0]}:{addr[1]}")
+    return urls
 
 
 def shutdown():
     """Tear down all deployments, the controller, and the ingress."""
-    for actor_name in ("_serve_http", CONTROLLER_NAME):
+    from ray_tpu._private.worker import get_core
+    fleet = []
+    try:
+        fleet = [r["name"] for r in
+                 get_core().gcs_request({"type": "list_named_actors"})
+                 if r["name"].startswith("_serve_http:")]
+    except Exception:
+        pass
+    for actor_name in (*fleet, "_serve_http", CONTROLLER_NAME):
         try:
             a = ray_tpu.get_actor(actor_name)
             if actor_name == CONTROLLER_NAME:

@@ -40,6 +40,10 @@ class DeploymentSpec:
     # Arbitrary config pushed to live replicas via reconfigure() without a
     # restart (reference: deployment user_config + replica reconfigure).
     user_config: Optional[Dict[str, Any]] = None
+    # Per-replica runtime env (reference: ray_actor_options.runtime_env);
+    # e.g. env_vars pinning one deployment's workers to the TPU platform
+    # while the cluster default keeps workers on CPU.
+    runtime_env: Optional[Dict[str, Any]] = None
 
 
 class Replica:
@@ -116,6 +120,13 @@ class ServeController:
         self._shutdown = False
         self._loop_task = None
         self._metrics: Dict[str, List[float]] = {}  # queue-len history
+        # Health-probe grace for initializing replicas (reference:
+        # initial health-check period in deployment_state): a replica
+        # whose __init__ is still compiling a jitted model must not be
+        # killed for missing a 10s ping.  actor_id -> created monotonic;
+        # ids that have answered once graduate to the normal probe.
+        self._replica_created: Dict[str, float] = {}
+        self._replica_seen_healthy: set = set()
         # deploy() and the background loop both reconcile; without this
         # lock a concurrent `reps[:] = alive` clobbers (and orphans)
         # replicas the other invocation just created.
@@ -202,7 +213,8 @@ class ServeController:
             old.callable_blob != spec.callable_blob or
             old.max_concurrent_queries != spec.max_concurrent_queries or
             old.num_cpus != spec.num_cpus or
-            old.resources != spec.resources)
+            old.resources != spec.resources or
+            old.runtime_env != spec.runtime_env)
         config_changed = (old is not None and not code_changed
                           and old.user_config != spec.user_config)
         self.deployments[spec.name] = spec
@@ -250,6 +262,9 @@ class ServeController:
                                           "no_restart": True})
         except Exception:
             pass
+        # keep the health-grace bookkeeping bounded under replica churn
+        self._replica_created.pop(handle._actor_id, None)
+        self._replica_seen_healthy.discard(handle._actor_id)
 
     async def delete_deployment(self, name: str) -> bool:
         # Under the reconcile lock: an in-flight reconcile that already
@@ -317,7 +332,6 @@ class ServeController:
         the async GCS channel directly — this coroutine runs ON the core
         IO loop, where the blocking kv_put wrapper would deadlock."""
         import json as _json
-        import time as _time
 
         from ray_tpu._private.worker import get_core
         status = {
@@ -331,7 +345,7 @@ class ServeController:
         await get_core().gcs.request({
             "type": "kv_put", "ns": "serve", "key": b"status",
             "value": _json.dumps({"deployments": status,
-                                  "updated_at": _time.time()}).encode(),
+                                  "updated_at": time.time()}).encode(),
             "overwrite": True})
         import cloudpickle
         state = {
@@ -351,9 +365,23 @@ class ServeController:
         from ray_tpu.actor import ActorHandle
 
         async def probe(r):
+            aid = r._actor_id
+            fresh = aid not in self._replica_seen_healthy
+            if fresh and time.monotonic() - self._replica_created.get(
+                    aid, 0.0) < 120.0:
+                # Init grace: give a replica still constructing (model
+                # load / jit compile) the full window before the 10s
+                # liveness bar applies.
+                try:
+                    await asyncio.wait_for(r.ping.remote(), timeout=1.0)
+                    self._replica_seen_healthy.add(aid)
+                except Exception:
+                    pass
+                return True
             try:
                 # ObjectRef is awaitable; wait_for wraps it.
                 await asyncio.wait_for(r.ping.remote(), timeout=10)
+                self._replica_seen_healthy.add(aid)
                 return True
             except Exception:
                 return False
@@ -380,14 +408,22 @@ class ServeController:
                     # max_concurrency has headroom over the request bound:
                     # requests queue inside the replica (visible to
                     # queue_len) instead of at the actor layer.
+                    scheduling = None
+                    if spec.runtime_env:
+                        from ray_tpu.remote_function import \
+                            _build_scheduling
+                        scheduling = _build_scheduling(
+                            {"runtime_env": spec.runtime_env})
                     actor_id = await get_core().create_actor_async(
                         Replica,
                         (spec.callable_blob, spec.max_concurrent_queries,
                          spec.user_config),
                         {},
                         resources=resources,
+                        scheduling=scheduling,
                         max_concurrency=4 * spec.max_concurrent_queries + 8,
                         name=f"_serve:{name}:{self._replica_seq}")
+                    self._replica_created[actor_id] = time.monotonic()
                     reps.append(ActorHandle(actor_id, "Replica"))
                 victims = []
                 while len(reps) > target:

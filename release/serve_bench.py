@@ -1,0 +1,177 @@
+"""Serving benchmark: GPT-2 generation through a ray_tpu.serve replica.
+
+Design analog: reference ``release/serve_tests/`` (serve throughput +
+latency percentiles release jobs).  A single replica holds the model and a
+jitted greedy-decode step; requests batch through ``@serve.batch``; the
+driver fires concurrent requests via the DeploymentHandle router and
+reports tokens/s plus p50/p99 end-to-end latency.
+
+On the TPU box the replica runs GPT-2-small on the chip (the replica's
+runtime_env pins JAX_PLATFORMS while every other worker stays on CPU —
+only one process may hold the chip); without a TPU it falls back to the
+tiny config on CPU so the harness always emits parseable JSON.
+
+Emits JSON lines:
+  {"metric": "serve_gpt2_tokens_per_sec", "value": ..., "p50_ms": ...,
+   "p99_ms": ..., "vs_baseline": null}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+
+def _probe_tpu() -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            timeout=70)
+        return proc.returncode == 0 and \
+            not proc.stdout.strip().startswith("cpu")
+    except subprocess.TimeoutExpired:
+        return False
+
+
+from ray_tpu import serve as _serve_mod
+
+
+class GPTGenerator:
+    """Serve replica: jitted greedy decoder over a fixed-length prompt.
+
+    Batched via serve.batch so concurrent HTTP/handle requests share one
+    MXU dispatch (the TPU-first analog of the reference's
+    @serve.batch-wrapped torch model replicas)."""
+
+    PROMPT_LEN = 64
+    GEN_TOKENS = 32
+
+    @_serve_mod.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def _batched(self, prompts):
+        return self._decode_batch(prompts)
+
+    def __init__(self, on_tpu: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init
+
+        cfg = GPTConfig.gpt2_small() if on_tpu else GPTConfig.tiny()
+        cfg = type(cfg)(**{**cfg.__dict__,
+                           "max_seq_len": self.PROMPT_LEN
+                           + self.GEN_TOKENS})
+        self.cfg = cfg
+        self.params = gpt_init(jax.random.PRNGKey(0), cfg)
+
+        def gen(params, tokens):
+            def body(toks, i):
+                logits = gpt_forward(params, toks, cfg)
+                pos = self.PROMPT_LEN - 1 + i
+                nxt = jnp.argmax(logits[:, pos, :], axis=-1)
+                toks = jax.lax.dynamic_update_slice_in_dim(
+                    toks, nxt[:, None], pos + 1, axis=1)
+                return toks, None
+
+            toks, _ = jax.lax.scan(body, tokens,
+                                   jnp.arange(self.GEN_TOKENS))
+            return toks
+
+        self._gen = jax.jit(gen)
+        import numpy as np
+        warm = np.zeros((8, self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
+        float(self._gen(self.params, warm)[0, 0])   # compile
+
+    def _decode_batch(self, prompts):
+        import numpy as np
+        # Pad to the max batch size so every flush hits ONE compiled
+        # shape (a fresh jit compile inside the timed loop would
+        # dominate p99).
+        toks = np.zeros((8, self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
+        for i, p in enumerate(prompts):
+            ids = (p if isinstance(p, list)
+                   else [ord(c) % 255 for c in str(p)])
+            ids = ids[:self.PROMPT_LEN]
+            toks[i, :len(ids)] = ids
+        out = self._gen(self.params, toks)
+        return np.asarray(out[:len(prompts), self.PROMPT_LEN:]).tolist()
+
+    async def __call__(self, prompt):
+        return await self._batched(prompt)
+
+
+def main() -> None:
+    on_tpu = _probe_tpu() and os.environ.get("RT_SERVE_BENCH_CPU") != "1"
+    n_requests = int(os.environ.get("RT_SERVE_BENCH_REQUESTS",
+                                    96 if on_tpu else 32))
+    concurrency = 16
+
+    import ray_tpu
+    from ray_tpu import serve
+    ray_tpu.init(num_cpus=4, _worker_env={"JAX_PLATFORMS": "cpu"},
+                 log_level="ERROR")
+    try:
+        renv = None
+        if on_tpu:
+            renv = {"env_vars": {
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "axon"),
+                "PALLAS_AXON_POOL_IPS":
+                    os.environ.get("PALLAS_AXON_POOL_IPS", ""),
+            }}
+        dep = serve.deployment(
+            name="gpt_gen",
+            max_concurrent_queries=32,
+            ray_actor_options={"runtime_env": renv} if renv else {},
+        )(GPTGenerator)
+        handle = serve.run(dep.bind(on_tpu))
+
+        prompt = list(range(GPTGenerator.PROMPT_LEN))
+        # warmup through the full path
+        ray_tpu.get(handle.remote(prompt), timeout=600)
+
+        lat: list = []
+        t0 = time.perf_counter()
+        pending = []
+        sent = 0
+        while sent < n_requests or pending:
+            while sent < n_requests and len(pending) < concurrency:
+                pending.append((time.perf_counter(),
+                                handle.remote(prompt)))
+                sent += 1
+            start, ref = pending.pop(0)
+            ray_tpu.get(ref, timeout=600)
+            lat.append(time.perf_counter() - start)
+        wall = time.perf_counter() - t0
+
+        toks = n_requests * GPTGenerator.GEN_TOKENS
+        lat_sorted = sorted(lat)
+        result = {
+            "metric": ("serve_gpt2_tokens_per_sec" if on_tpu
+                       else "serve_gpt2_cpu_smoke_tokens_per_sec"),
+            "value": round(toks / wall, 2),
+            "unit": "tokens/s",
+            "requests_per_sec": round(n_requests / wall, 2),
+            "p50_ms": round(
+                statistics.median(lat_sorted) * 1000, 1),
+            "p99_ms": round(   # nearest-rank p99
+                lat_sorted[max(0, -(-99 * len(lat_sorted) // 100) - 1)]
+                * 1000, 1),
+            "n_requests": n_requests,
+            "vs_baseline": None,
+        }
+        print(json.dumps(result), flush=True)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -86,3 +86,43 @@ def test_actor_node_death_restart(cluster):
             assert time.monotonic() < deadline, "actor never recovered"
             time.sleep(0.5)
     assert nid == n3.node_id
+
+
+def test_per_node_serve_ingress_fleet(cluster):
+    """One HTTP ingress per node (reference: HTTPProxyActor per node,
+    http_proxy.py:387): every node's ingress serves every route, so
+    serving has no single-actor bottleneck or SPOF."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="fleet_echo", route_prefix="/fleet_echo")
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    serve.run(Echo.bind())
+    try:
+        n_alive = sum(1 for n in ray_tpu.nodes() if n["alive"])
+        first = serve.start_http(per_node=True)
+        urls = serve.http_addresses()
+        assert len(urls) == n_alive >= 2, urls   # one ingress per node
+        assert first in urls
+        deadline = time.time() + 30
+        for base in urls:
+            while True:   # route table fills via refresh loop
+                req = urllib.request.Request(
+                    f"{base}/fleet_echo", data=json.dumps("hi").encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        assert json.loads(r.read())["result"] == {
+                            "echo": "hi"}
+                    break
+                except urllib.error.HTTPError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+    finally:
+        serve.shutdown()
