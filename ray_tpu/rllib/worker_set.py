@@ -49,6 +49,14 @@ class WorkerSet:
         if config.get("output"):
             from ray_tpu.rllib.offline import DatasetWriter
             self._output_writer = DatasetWriter(config["output"])
+        # Client-server RL (reference: PolicyServerInput as config.input):
+        # external simulator processes drive episodes over TCP; sample()
+        # returns their experiences instead of rollout-worker batches.
+        self.server_input = None
+        if config.get("input") == "policy_server":
+            from ray_tpu.rllib.policy_server import PolicyServerInput
+            self.server_input = PolicyServerInput(
+                self.local_worker.policy, config)
 
     def _make_remote(self, index: int):
         return self._remote_cls.remote(self.config, index)
@@ -65,6 +73,11 @@ class WorkerSet:
     def synchronous_sample(self) -> SampleBatch:
         """One round of parallel sampling across all workers (reference
         rollout_ops.synchronous_parallel_sample)."""
+        if self.server_input is not None:
+            batch = self.server_input.sample()
+            if self._output_writer is not None:
+                self._output_writer.write(batch)
+            return batch
         if not self.remote_workers:
             batch = self.local_worker.sample()
         else:
@@ -78,7 +91,14 @@ class WorkerSet:
     def collect_metrics(self) -> Dict[str, Any]:
         rewards: List[float] = []
         lens: List[int] = []
-        if self.remote_workers:
+        if self.server_input is not None:
+            # matches synchronous_sample's precedence: with a policy
+            # server, rollout workers never sample, so their metrics
+            # would be permanently empty
+            m = self.server_input.get_metrics()
+            rewards.extend(m["episode_rewards"])
+            lens.extend(m["episode_lens"])
+        elif self.remote_workers:
             for m in ray_tpu.get(
                     [w.get_metrics.remote() for w in self.remote_workers],
                     timeout=60.0):
@@ -144,6 +164,8 @@ class WorkerSet:
         return out
 
     def stop(self) -> None:
+        if self.server_input is not None:
+            self.server_input.stop()
         for w in self.remote_workers:
             try:
                 ray_tpu.kill(w)

@@ -51,8 +51,9 @@ class GPTGenerator:
 
     PROMPT_LEN = 64
     GEN_TOKENS = 32
+    MAX_BATCH = 8   # shared by the batch queue, pad buffer, and warmup
 
-    @_serve_mod.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    @_serve_mod.batch(max_batch_size=MAX_BATCH, batch_wait_timeout_s=0.02)
     async def _batched(self, prompts):
         return self._decode_batch(prompts)
 
@@ -84,7 +85,8 @@ class GPTGenerator:
 
         self._gen = jax.jit(gen)
         import numpy as np
-        warm = np.zeros((8, self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
+        warm = np.zeros((self.MAX_BATCH,
+                         self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
         float(self._gen(self.params, warm)[0, 0])   # compile
 
     def _decode_batch(self, prompts):
@@ -92,7 +94,8 @@ class GPTGenerator:
         # Pad to the max batch size so every flush hits ONE compiled
         # shape (a fresh jit compile inside the timed loop would
         # dominate p99).
-        toks = np.zeros((8, self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
+        toks = np.zeros((self.MAX_BATCH,
+                         self.PROMPT_LEN + self.GEN_TOKENS), np.int32)
         for i, p in enumerate(prompts):
             ids = (p if isinstance(p, list)
                    else [ord(c) % 255 for c in str(p)])
