@@ -16,6 +16,8 @@ from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                register_env)
 from ray_tpu.rllib.catalog import AttentionPPOPolicy, ModelCatalog
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig
+from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
 from ray_tpu.rllib.offline import (BC, BCConfig, BCPolicy, CQL, CQLConfig,
                                    DatasetReader, DatasetWriter,
                                    ImportanceSamplingEstimator, MARWIL,
@@ -44,7 +46,8 @@ __all__ = [
     "Env", "Impala",
     "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",
     "MARWIL", "MARWILConfig", "MARWILPolicy",
-    "PendulumVectorEnv", "Policy", "PPO", "PPOConfig", "PPOPolicy",
+    "PendulumVectorEnv", "Policy", "PolicyClient", "PolicyServerInput",
+    "PPO", "PPOConfig", "PPOPolicy", "QMIX", "QMIXConfig",
     "PrioritizedReplayBuffer", "RecurrentPPO", "RecurrentPPOConfig",
     "RecurrentPPOPolicy", "ReplayBuffer", "RolloutWorker", "SampleBatch",
     "Space", "TD3", "TD3Config", "TD3Policy", "VectorEnv", "WorkerSet",

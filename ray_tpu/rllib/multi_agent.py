@@ -111,8 +111,66 @@ class CoordinationGameEnv(MultiAgentEnv):
         return obs, rewards, dones, {}
 
 
+class TwoStepGameEnv(MultiAgentEnv):
+    """The QMIX paper's two-step cooperative game (Rashid et al. 2018).
+
+    Step 1: agent_0's action picks the second-stage game (agent_1's
+    first action is ignored).  Step 2A pays 7 regardless; step 2B pays
+    [[0, 1], [1, 8]].  The optimum (8) requires agent_0 to choose the
+    risky branch AND both agents to coordinate on action 1 there —
+    independent learners settle on the safe 7, which is exactly the
+    credit-assignment gap value factorization exists to close.
+    Reference analog: ``rllib/examples/env/two_step_game.py`` (the env
+    the reference's QMIX tests learn on).
+    """
+
+    PAYOFF_2B = ((0.0, 1.0), (1.0, 8.0))
+
+    def __init__(self, seed: int = 0):
+        from ray_tpu.rllib.env import Space
+        self.agents = ["agent_0", "agent_1"]
+        self.observation_space = Space("box", shape=(5,))
+        self.action_space = Space("discrete", n=2)
+        self._state = 0     # 0 = first step, 1 = 2A, 2 = 2B
+
+    def state(self) -> np.ndarray:
+        v = np.zeros(3, np.float32)
+        v[self._state] = 1.0
+        return v
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, a in enumerate(self.agents):
+            v = np.zeros(5, np.float32)
+            v[self._state] = 1.0
+            v[3 + i] = 1.0
+            out[a] = v
+        return out
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        self._state = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, Any]):
+        if self._state == 0:
+            self._state = 1 if int(actions["agent_0"]) == 0 else 2
+            r, done = 0.0, False
+        elif self._state == 1:
+            r, done = 7.0, True
+        else:
+            r = self.PAYOFF_2B[int(actions["agent_0"])][
+                int(actions["agent_1"])]
+            done = True
+        obs = self._obs()
+        rewards = {a: r for a in self.agents}
+        dones = {a: done for a in self.agents}
+        dones["__all__"] = done
+        return obs, rewards, dones, {}
+
+
 MA_ENV_REGISTRY: Dict[str, Callable[..., MultiAgentEnv]] = {
     "CoordinationGame-v0": CoordinationGameEnv,
+    "TwoStepGame-v0": TwoStepGameEnv,
 }
 
 

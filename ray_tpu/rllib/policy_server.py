@@ -62,7 +62,20 @@ class PolicyServerInput:
     algorithm-facing side (drop-in for the rollout-sampling path)."""
 
     def __init__(self, policy, config: Dict[str, Any]):
+        # The server drives the policy's PURE jitted actor (params, rng,
+        # obs) -> (actions, logp, values) with a PRNG the server owns:
+        # calling the stateful compute_actions here would race the
+        # learner thread's own rng split.  Only actor-critic on-policy
+        # policies expose this surface — fail at build, not per request.
+        if not hasattr(policy, "_act") or \
+                not hasattr(policy, "compute_values"):
+            raise ValueError(
+                "input='policy_server' needs an actor-critic on-policy "
+                f"policy (PPO-family); got {type(policy).__name__}")
         self._policy = policy
+        import jax
+        self._jax = jax
+        self._jrng = jax.random.PRNGKey(config.get("seed", 0) + 31337)
         self._gamma = config.get("gamma", 0.99)
         self._lambda = config.get("lambda", 0.95)
         # One train batch per fragment of completed external steps.
@@ -133,14 +146,16 @@ class PolicyServerInput:
         if cmd == "get_action":
             obs = np.asarray(msg["obs"], np.float32)[None]
             with self._infer_lock:
-                out = self._policy.compute_actions(obs)
-            act = np.asarray(out[ACTIONS])[0]
+                self._jrng, rng = self._jax.random.split(self._jrng)
+                actions, logp, v = self._policy._act(
+                    self._policy.params, rng, obs)
+            act = np.asarray(actions)[0]
             with self._lock:
                 ep = self._episodes[eid]
                 ep.obs.append(obs[0])
                 ep.actions.append(act)
-                ep.logps.append(float(out[ACTION_LOGP][0]))
-                ep.vfs.append(float(out.get(VF_PREDS, [0.0])[0]))
+                ep.logps.append(float(np.asarray(logp)[0]))
+                ep.vfs.append(float(np.asarray(v)[0]))
                 ep.rewards.append(0.0)   # filled by log_returns
             return {"action": act.tolist() if hasattr(act, "tolist")
                     else act}
@@ -241,10 +256,6 @@ class PolicyClient:
                                                int(port)), timeout=timeout)
         self._f = self._sock.makefile("rwb")
         self._lock = threading.Lock()
-        # Inference serializes on its own lock so a slow (first, jit
-        # compiling) compute_actions never blocks end_episode/sample
-        # bookkeeping on the main lock.
-        self._infer_lock = threading.Lock()
 
     def _call(self, msg: dict) -> dict:
         with self._lock:

@@ -219,19 +219,25 @@ def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
                     NodeAffinitySchedulingStrategy(n["node_id"]))
                    for n in nodes if n["alive"]]
     urls = []
-    for i, (name, strategy) in enumerate(targets):
-        # A fixed port applies to the first ingress only: on simulated
-        # clusters every "node" shares one host, so binding the same
-        # port per node would EADDRINUSE; further ingresses take
-        # ephemeral ports (on real multi-host clusters each node's bind
-        # is distinct anyway and the route table is identical).
-        node_port = port if i == 0 else 0
-        ingress = ingress_cls.options(
-            name=name, lifetime="detached", get_if_exists=True,
-            num_cpus=0, max_concurrency=64,
-            scheduling_strategy=strategy).remote(
-            host, node_port, global_worker.namespace)
-        addr = ray_tpu.get(ingress.address.remote(), timeout=60)
+    for name, strategy in targets:
+        # Every node's ingress tries the requested port (on real
+        # multi-host clusters the binds are on distinct hosts).  Only on
+        # an actual bind conflict — simulated clusters share one host —
+        # does that node's ingress fall back to an ephemeral port.
+        for node_port in ((port,) if port == 0 else (port, 0)):
+            ingress = ingress_cls.options(
+                name=name, lifetime="detached", get_if_exists=True,
+                num_cpus=0, max_concurrency=64,
+                scheduling_strategy=strategy).remote(
+                host, node_port, global_worker.namespace)
+            try:
+                addr = ray_tpu.get(ingress.address.remote(), timeout=60)
+                break
+            except Exception:
+                # bind failure surfaces as a wrapped TaskError(OSError);
+                # non-bind failures will fail the port-0 retry too and
+                # propagate from there
+                ray_tpu.kill(ingress)
         urls.append(f"http://{addr[0]}:{addr[1]}")
     return urls
 
