@@ -68,10 +68,14 @@ class PolicyServerInput:
         # learner thread's own rng split.  Only actor-critic on-policy
         # policies expose this surface — fail at build, not per request.
         if not hasattr(policy, "_act") or \
-                not hasattr(policy, "compute_values"):
+                not hasattr(policy, "compute_values") or \
+                hasattr(policy, "_ensure_state"):
+            # recurrent policies carry rollout state whose _act signature
+            # differs — reject them here too, not per request
             raise ValueError(
-                "input='policy_server' needs an actor-critic on-policy "
-                f"policy (PPO-family); got {type(policy).__name__}")
+                "input='policy_server' needs a non-recurrent actor-critic "
+                f"on-policy policy (PPO-family); got "
+                f"{type(policy).__name__}")
         self._policy = policy
         import jax
         self._jax = jax

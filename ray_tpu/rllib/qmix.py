@@ -180,7 +180,9 @@ class QMIX(Trainable):
 
         self._greedy = _greedy
 
-        self._buffer: List[dict] = []
+        from ray_tpu.rllib.replay_buffer import ReplayBuffer
+        self._buffer = ReplayBuffer(capacity=c.get("buffer_size", 5000),
+                                    seed=c.get("seed", 0))
         self._steps = 0
         self._updates = 0
         self._episode_rewards: List[float] = []
@@ -221,18 +223,18 @@ class QMIX(Trainable):
             nstate = self._global_state(nobs)
             done = dones["__all__"]
             r = float(rewards[self.agents[0]])   # shared team reward
-            self._buffer.append({
-                "obs": stacked,
+            from ray_tpu.rllib.sample_batch import SampleBatch
+            self._buffer.add(SampleBatch({
+                "obs": stacked[None],
                 "actions": np.asarray([acts[a] for a in self.agents],
-                                      np.int32),
-                "rewards": r,
-                "dones": done,
-                "state": state,
-                "next_obs": np.stack([nobs[a] for a in self.agents]),
-                "next_state": nstate,
-            })
-            if len(self._buffer) > self.config.get("buffer_size", 5000):
-                self._buffer.pop(0)
+                                      np.int32)[None],
+                "rewards": np.asarray([r], np.float32),
+                "dones": np.asarray([done]),
+                "state": state[None],
+                "next_obs": np.stack([nobs[a]
+                                      for a in self.agents])[None],
+                "next_state": nstate[None],
+            }))
             total += r
             obs, state = nobs, nstate
             self._steps += 1
@@ -241,23 +243,11 @@ class QMIX(Trainable):
     # -- training ---------------------------------------------------------
 
     def _sample_batch(self) -> Dict[str, jnp.ndarray]:
-        idx = self._np_rng.integers(
-            0, len(self._buffer),
+        batch = self._buffer.sample(
             self.config.get("train_batch_size", 64))
-        rows = [self._buffer[i] for i in idx]
-        return {
-            "obs": jnp.asarray(np.stack([r["obs"] for r in rows])),
-            "actions": jnp.asarray(np.stack([r["actions"]
-                                             for r in rows])),
-            "rewards": jnp.asarray([r["rewards"] for r in rows],
-                                   jnp.float32),
-            "dones": jnp.asarray([r["dones"] for r in rows]),
-            "state": jnp.asarray(np.stack([r["state"] for r in rows])),
-            "next_obs": jnp.asarray(np.stack([r["next_obs"]
-                                              for r in rows])),
-            "next_state": jnp.asarray(np.stack([r["next_state"]
-                                                for r in rows])),
-        }
+        return {k: jnp.asarray(batch[k])
+                for k in ("obs", "actions", "rewards", "dones", "state",
+                          "next_obs", "next_state")}
 
     def step(self) -> Dict[str, Any]:
         c = self.config

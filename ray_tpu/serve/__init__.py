@@ -224,6 +224,8 @@ def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
         # multi-host clusters the binds are on distinct hosts).  Only on
         # an actual bind conflict — simulated clusters share one host —
         # does that node's ingress fall back to an ephemeral port.
+        addr = None
+        last_err: Optional[Exception] = None
         for node_port in ((port,) if port == 0 else (port, 0)):
             ingress = ingress_cls.options(
                 name=name, lifetime="detached", get_if_exists=True,
@@ -233,11 +235,15 @@ def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
             try:
                 addr = ray_tpu.get(ingress.address.remote(), timeout=60)
                 break
-            except Exception:
-                # bind failure surfaces as a wrapped TaskError(OSError);
-                # non-bind failures will fail the port-0 retry too and
-                # propagate from there
+            except Exception as e:
+                # a bind conflict surfaces as a wrapped TaskError(OSError)
+                # — retry once on an ephemeral port; anything that also
+                # fails the retry propagates below
+                last_err = e
                 ray_tpu.kill(ingress)
+        if addr is None:
+            raise RuntimeError(
+                f"serve ingress {name} failed to start") from last_err
         urls.append(f"http://{addr[0]}:{addr[1]}")
     return urls
 
