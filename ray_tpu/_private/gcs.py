@@ -373,16 +373,35 @@ class GcsServer:
                 if any(w["pid"] == pid for w in stats.get("workers", [])):
                     target = nid
                     break
+        req = {"type": "profile_worker", "pid": pid,
+               "duration": msg.get("duration", 5.0),
+               "interval": msg.get("interval", 0.01)}
+        req_timeout = float(msg.get("duration", 5.0)) + 40.0
         if target is None:
+            # The stats view is periodic and a freshly spawned worker
+            # (forkserver spawns are ~20ms) may not be in it yet: ask
+            # every live raylet IN PARALLEL (a wedged node must not
+            # stall the one actually hosting the pid); misses answer
+            # fast, first ok wins.
+            async def ask(node):
+                try:
+                    return await node.conn.request(req,
+                                                   timeout=req_timeout)
+                except Exception as e:
+                    return {"ok": False, "error": repr(e)}
+
+            live = [n for n in self.nodes.values() if n.alive and n.conn]
+            replies = await asyncio.gather(*[ask(n) for n in live])
+            for r in replies:
+                if r.get("ok"):
+                    return r
             return {"ok": False,
-                    "error": f"no node reports a worker with pid {pid}"}
+                    "error": f"no node reports a worker with pid {pid}: "
+                             + "; ".join(str(r.get("error"))
+                                         for r in replies)}
         for node in self.nodes.values():
             if node.node_id.hex() == target and node.alive and node.conn:
-                return await node.conn.request(
-                    {"type": "profile_worker", "pid": pid,
-                     "duration": msg.get("duration", 5.0),
-                     "interval": msg.get("interval", 0.01)},
-                    timeout=float(msg.get("duration", 5.0)) + 40.0)
+                return await node.conn.request(req, timeout=req_timeout)
         return {"ok": False, "error": f"node {target} not alive"}
 
     # ------------------------------------------------------------------ kv

@@ -135,18 +135,41 @@ def run_test(test: dict) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="smoke")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated entry names (any suite); "
+                         "results MERGE into --out by name instead of "
+                         "replacing the file")
     ap.add_argument("--yaml", default=os.path.join(
         REPO, "release", "release_tests.yaml"))
     ap.add_argument("--out", default=os.path.join(
         REPO, "release", "release_results.json"))
     args = ap.parse_args()
 
-    tests = [t for t in load_suite(args.yaml)
-             if args.suite in t.get("suite", [])]
+    if args.only:
+        names = {n.strip() for n in args.only.split(",")}
+        tests = [t for t in load_suite(args.yaml) if t["name"] in names]
+        missing = names - {t["name"] for t in tests}
+        if missing:
+            print(f"error: unknown entries {sorted(missing)}",
+                  file=sys.stderr)
+            sys.exit(2)
+    else:
+        tests = [t for t in load_suite(args.yaml)
+                 if args.suite in t.get("suite", [])]
     if not tests:
         print(f"error: no tests match suite {args.suite!r}",
               file=sys.stderr)
         sys.exit(2)
+    prior = None
+    if args.only and os.path.exists(args.out):
+        # read the doc BEFORE the (possibly hour-long) run: a corrupt
+        # file must fail fast, not after the work
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"warning: {args.out} unreadable ({e!r}); writing a "
+                  f"fresh results file", file=sys.stderr)
     results = []
     for t in tests:
         print(f"=== {t['name']} ({t['entrypoint']})", flush=True)
@@ -154,9 +177,20 @@ def main():
         print(f"    {'PASS' if r['passed'] else 'FAIL'} "
               f"in {r['duration_s']}s {r['failures'] or ''}", flush=True)
         results.append(r)
-    with open(args.out, "w") as f:
-        json.dump({"suite": args.suite, "when": time.time(),
-                   "results": results}, f, indent=2)
+    if prior is not None:
+        # refresh selected entries in place, keep the rest
+        doc = prior
+        by_name = {r["name"]: r for r in doc.get("results", [])}
+        by_name.update({r["name"]: r for r in results})
+        doc["results"] = list(by_name.values())
+        doc["when"] = time.time()
+    else:
+        doc = {"suite": args.suite, "when": time.time(),
+               "results": results}
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, args.out)
     sys.exit(0 if all(r["passed"] for r in results) else 1)
 
 

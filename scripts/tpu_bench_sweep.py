@@ -32,16 +32,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def probe() -> bool:
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=70)
-        return p.returncode == 0 and not p.stdout.strip().startswith(
-            "cpu")
-    except subprocess.TimeoutExpired:
-        return False
+    sys.path.insert(0, REPO)
+    import bench
+    return bench._probe_tpu()   # 4 attempts with backoff (flaps recover)
 
 
 def run_point(env_extra: dict, label: str, timeout_s: int = 600):
@@ -66,6 +59,12 @@ def run_point(env_extra: dict, label: str, timeout_s: int = 600):
         r = json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as e:
         print(f"[{label}] unparseable: {e!r}", flush=True)
+        return None
+    if r.get("metric") != "gpt2_small_train_samples_per_sec_per_chip":
+        # tunnel dropped between probe and child: the child fell back to
+        # a CPU smoke whose tiny-model number must not enter the sweep
+        print(f"[{label}] child ran on CPU ({r.get('metric')}); "
+              f"discarding", flush=True)
         return None
     r["_label"] = label
     r["_wall_s"] = round(time.time() - t0, 1)
@@ -123,10 +122,13 @@ def main() -> int:
     print(f"\nBEST: {best['_label']} -> {best['value']} samples/s, "
           f"mfu={best.get('mfu')}", flush=True)
     # leave the best as last-good so the driver's bench re-emits it
-    with open(os.path.join(REPO, "BENCH_LASTGOOD.json"), "w") as f:
+    # (atomic: a kill mid-write must not destroy the only copy)
+    lastgood = os.path.join(REPO, "BENCH_LASTGOOD.json")
+    with open(lastgood + ".tmp", "w") as f:
         json.dump({k: v for k, v in best.items()
                    if not k.startswith("_")} | {
                        "recorded_at": time.time()}, f, indent=2)
+    os.replace(lastgood + ".tmp", lastgood)
     return 0
 
 
