@@ -385,20 +385,32 @@ class GcsServer:
             # fast, first ok wins.
             async def ask(node):
                 try:
-                    return await node.conn.request(req,
-                                                   timeout=req_timeout)
+                    r = await node.conn.request(req, timeout=req_timeout)
                 except Exception as e:
-                    return {"ok": False, "error": repr(e)}
+                    r = {"ok": False, "error": repr(e)}
+                # pids are only per-host unique: tag the answering node
+                # so a cross-host collision is at least attributable
+                r.setdefault("node_id", node.node_id.hex())
+                return r
 
             live = [n for n in self.nodes.values() if n.alive and n.conn]
-            replies = await asyncio.gather(*[ask(n) for n in live])
-            for r in replies:
-                if r.get("ok"):
-                    return r
+            pending = {asyncio.ensure_future(ask(n)) for n in live}
+            errors = []
+            try:
+                while pending:
+                    done, pending = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED)
+                    for fut in done:
+                        r = fut.result()
+                        if r.get("ok"):
+                            return r   # first ok wins; losers cancelled
+                        errors.append(str(r.get("error")))
+            finally:
+                for fut in pending:
+                    fut.cancel()
             return {"ok": False,
                     "error": f"no node reports a worker with pid {pid}: "
-                             + "; ".join(str(r.get("error"))
-                                         for r in replies)}
+                             + "; ".join(errors)}
         for node in self.nodes.values():
             if node.node_id.hex() == target and node.alive and node.conn:
                 return await node.conn.request(req, timeout=req_timeout)

@@ -167,9 +167,15 @@ def main():
         try:
             with open(args.out) as f:
                 prior = json.load(f)
-        except (json.JSONDecodeError, OSError) as e:
-            print(f"warning: {args.out} unreadable ({e!r}); writing a "
+        except json.JSONDecodeError as e:
+            print(f"warning: {args.out} corrupt ({e!r}); writing a "
                   f"fresh results file", file=sys.stderr)
+        except OSError as e:
+            # a transient read error must not end in os.replace()ing
+            # away every other suite entry an hour later
+            print(f"error: cannot read {args.out} ({e!r})",
+                  file=sys.stderr)
+            sys.exit(2)
     results = []
     for t in tests:
         print(f"=== {t['name']} ({t['entrypoint']})", flush=True)

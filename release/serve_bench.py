@@ -18,11 +18,16 @@ Emits JSON lines:
 
 from __future__ import annotations
 
-import json
 import os
+import sys
+
+# Runnable as `python release/<script>.py`: python puts the SCRIPT's dir
+# on sys.path, not the repo root where ray_tpu lives.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
 import statistics
 import subprocess
-import sys
 import time
 
 

@@ -31,9 +31,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+sys.path.insert(0, REPO)
+import bench
+
+
 def probe() -> bool:
-    sys.path.insert(0, REPO)
-    import bench
     return bench._probe_tpu()   # 4 attempts with backoff (flaps recover)
 
 
@@ -60,7 +62,7 @@ def run_point(env_extra: dict, label: str, timeout_s: int = 600):
     except Exception as e:
         print(f"[{label}] unparseable: {e!r}", flush=True)
         return None
-    if r.get("metric") != "gpt2_small_train_samples_per_sec_per_chip":
+    if r.get("metric") != bench.TPU_METRIC:
         # tunnel dropped between probe and child: the child fell back to
         # a CPU smoke whose tiny-model number must not enter the sweep
         print(f"[{label}] child ran on CPU ({r.get('metric')}); "
