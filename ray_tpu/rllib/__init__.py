@@ -16,6 +16,7 @@ from ray_tpu.rllib.env import (CartPoleVectorEnv, Env, PendulumVectorEnv,
                                register_env)
 from ray_tpu.rllib.catalog import AttentionPPOPolicy, ModelCatalog
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, ImpalaPolicy
+from ray_tpu.rllib.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
 from ray_tpu.rllib.offline import (BC, BCConfig, BCPolicy, CQL, CQLConfig,
@@ -46,6 +47,7 @@ __all__ = [
     "Env", "Impala",
     "ImpalaConfig", "ImpalaPolicy", "ImportanceSamplingEstimator",
     "MARWIL", "MARWILConfig", "MARWILPolicy",
+    "ApexDQN", "ApexDQNConfig",
     "PendulumVectorEnv", "Policy", "PolicyClient", "PolicyServerInput",
     "PPO", "PPOConfig", "PPOPolicy", "QMIX", "QMIXConfig",
     "PrioritizedReplayBuffer", "RecurrentPPO", "RecurrentPPOConfig",
