@@ -71,6 +71,23 @@ class DQNPolicy(Policy):
         self._tx = optax.adam(config.get("lr", 5e-4))
         self.opt_state = self._tx.init(self.params)
         self._steps_seen = 0
+        # Exploration modules (reference rllib/utils/exploration/):
+        # parameter-space noise replaces epsilon-greedy; RND curiosity
+        # adds an intrinsic novelty bonus at learn time.
+        self._param_noise = None
+        if config.get("exploration") == "parameter_noise":
+            from ray_tpu.rllib.exploration import ParameterNoise
+            self._param_noise = ParameterNoise(
+                seed=seed,
+                initial_sigma=config.get("param_noise_sigma", 0.05),
+                target_divergence=config.get(
+                    "param_noise_target", 0.1))
+            self._noisy_params = self._param_noise.perturb(self.params)
+            self._since_perturb = 0
+        self._rnd = None
+        if config.get("rnd_coeff", 0.0) > 0.0:
+            from ray_tpu.rllib.exploration import RNDCuriosity
+            self._rnd = RNDCuriosity(obs_dim, seed=seed)
 
         gamma = config.get("gamma", 0.99)
         double_q = config.get("double_q", True)
@@ -127,10 +144,28 @@ class DQNPolicy(Policy):
         return self._epsilon_at(self._steps_seen * samplers)
 
     def compute_actions(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
-        q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))
+        jobs = jnp.asarray(obs, jnp.float32)
+        self._steps_seen += len(obs)
+        if self._param_noise is not None:
+            # parameter-space exploration: act greedily under the
+            # PERTURBED network; re-perturb + adapt sigma periodically
+            # (temporally consistent exploration, unlike per-step eps)
+            self._since_perturb += len(obs)
+            if self._since_perturb >= self.config.get(
+                    "param_noise_interval", 64):
+                clean = np.asarray(self._q(self.params,
+                                           jobs)).argmax(axis=1)
+                noisy = np.asarray(self._q(self._noisy_params,
+                                           jobs)).argmax(axis=1)
+                self._param_noise.adapt_sigma(clean, noisy)
+                self._noisy_params = self._param_noise.perturb(
+                    self.params)
+                self._since_perturb = 0
+            q = np.asarray(self._q(self._noisy_params, jobs))
+            return {ACTIONS: q.argmax(axis=1)}
+        q = np.asarray(self._q(self.params, jobs))
         greedy = q.argmax(axis=1)
         eps = self._epsilon()
-        self._steps_seen += len(obs)
         explore = self._rng.random(len(obs)) < eps
         random_a = self._rng.integers(0, self.num_actions, len(obs))
         return {ACTIONS: np.where(explore, random_a, greedy)}
@@ -138,6 +173,17 @@ class DQNPolicy(Policy):
     # -- learner side -----------------------------------------------------
 
     def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        if self._rnd is not None:
+            # intrinsic novelty bonus on the NEXT state (the state the
+            # action discovered); errors + predictor update fused in one
+            # jitted call
+            nxt = np.asarray(batch[NEXT_OBS], np.float32)
+            bonus = self._rnd.intrinsic_and_train(nxt)
+            batch = SampleBatch({**batch,
+                                 REWARDS: np.asarray(
+                                     batch[REWARDS], np.float32)
+                                 + self.config.get("rnd_coeff", 0.0)
+                                 * bonus})
         weights = jnp.asarray(
             np.asarray(batch.get("weights",
                                  np.ones(batch.count)), np.float32))
@@ -162,6 +208,12 @@ class DQNPolicy(Policy):
 
     def set_weights(self, weights):
         self.params = jax.tree.map(jnp.asarray, weights)
+        if self._param_noise is not None:
+            # act under a perturbation of the FRESH weights immediately
+            # (stale noisy params would ignore a weight sync for up to
+            # param_noise_interval steps)
+            self._noisy_params = self._param_noise.perturb(self.params)
+            self._since_perturb = 0
 
 
 class DQN(Algorithm):

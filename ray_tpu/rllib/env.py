@@ -256,10 +256,59 @@ class RepeatPreviousVectorEnv(VectorEnv):
         return self._one_hot(), reward, done, info
 
 
+class SparseChainVectorEnv(VectorEnv):
+    """Exploration stress test (the NChain/DeepSea family): a length-N
+    chain where only the far-right state pays (+1) but a small distractor
+    (+0.01) pays for sitting at the start.  Greedy/epsilon agents latch
+    onto the distractor; novelty-driven exploration (RND) finds the end.
+    obs = one-hot position; actions: 0 = left, 1 = right.
+    """
+
+    def __init__(self, num_envs: int = 1, length: int = 16,
+                 max_episode_steps: int = 32, seed: int = 0):
+        super().__init__(num_envs)
+        self.length = length
+        self.observation_space = Space("box", shape=(length,), low=0.0,
+                                       high=1.0)
+        self.action_space = Space("discrete", n=2)
+        self.max_episode_steps = max_episode_steps
+        self.pos = np.zeros(num_envs, np.int64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.eye(self.length,
+                      dtype=np.float32)[self.pos]
+
+    def vector_reset(self, seed: Optional[int] = None) -> np.ndarray:
+        self.pos[:] = 0
+        self._steps[:] = 0
+        return self._obs()
+
+    def vector_step(self, actions: np.ndarray):
+        a = np.asarray(actions)
+        self.pos = np.clip(self.pos + np.where(a == 1, 1, -1), 0,
+                           self.length - 1)
+        self._steps += 1
+        at_goal = self.pos == self.length - 1
+        reward = np.where(at_goal, 1.0,
+                          np.where(self.pos == 0, 0.01, 0.0)
+                          ).astype(np.float32)
+        truncated = self._steps >= self.max_episode_steps
+        done = at_goal | truncated
+        info = {"terminal_obs": self._obs(), "truncated": truncated}
+        if done.any():
+            idx = np.nonzero(done)[0]
+            self.pos[idx] = 0
+            self._steps[idx] = 0
+        return self._obs(), reward, done, info
+
+
+
 _ENV_REGISTRY = {
     "CartPole-v1": CartPoleVectorEnv,
     "Pendulum-v1": PendulumVectorEnv,
     "RepeatPrevious-v0": RepeatPreviousVectorEnv,
+    "SparseChain-v0": SparseChainVectorEnv,
 }
 
 
