@@ -160,6 +160,9 @@ class Raylet:
         self.log_monitor = LogMonitor(
             node_id=node_id.hex(), publish=self._publish_logs)
         self._spill_lock = asyncio.Lock()
+        # spill/restore counters (node stats -> Dataset.stats footer)
+        self._spilled_objects = 0
+        self._restored_objects = 0
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
         # CPU-worker forkserver (lazy; see _private/forkserver.py): one
@@ -328,6 +331,8 @@ class Raylet:
             "object_store": store,
             "num_workers": len(workers),
             "workers": workers,
+            "spilled_objects": self._spilled_objects,
+            "restored_objects": self._restored_objects,
         }
 
     def _purge_dead_leases(self) -> None:
@@ -1050,6 +1055,7 @@ class Raylet:
                         pass
                     continue
                 freed += len(data)
+                self._spilled_objects += 1
             if freed:
                 logger.info("spilled %d bytes to %s", freed, self.spill_dir)
             return freed
@@ -1091,6 +1097,7 @@ class Raylet:
             buf[:] = data
             self.plasma.seal(oid)
             self.plasma.release(oid)
+            self._restored_objects += 1
         await self.gcs_conn.request({
             "type": "object_location_add", "object_id": oid.hex(),
             "node_id": self.node_id.hex()})

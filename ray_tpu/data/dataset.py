@@ -564,6 +564,15 @@ class Dataset:
                     f"{max(walls) * 1000:.2f}ms min/mean/max per block, "
                     f"rows {sum(s['rows_in'] for s in ss)} -> "
                     f"{sum(s['rows_out'] for s in ss)}")
+        try:
+            from ray_tpu.util.state import spill_totals
+            t = spill_totals()
+            lines.append(
+                f"Cluster objects spilled: {t['spilled_objects']}, "
+                f"restored: {t['restored_objects']} "
+                f"(lifetime totals; node stats refresh ~2s)")
+        except Exception:
+            pass   # stats channel unavailable (e.g. local_mode)
         return "\n".join(lines)
 
     # -- transforms -------------------------------------------------------

@@ -53,6 +53,17 @@ def list_workers() -> List[Dict[str, Any]]:
     return out
 
 
+def spill_totals() -> Dict[str, int]:
+    """Cluster-wide lifetime spill/restore object counts, summed over the
+    raylets' periodic stats pushes (refresh interval ~2s, so totals lag
+    live activity by up to one push)."""
+    stats = _gcs_request({"type": "get_node_stats"}) or {}
+    return {"spilled_objects": sum(s.get("spilled_objects", 0)
+                                   for s in stats.values()),
+            "restored_objects": sum(s.get("restored_objects", 0)
+                                    for s in stats.values())}
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects registered in the cluster object directory (plasma-sized;
     inline objects live in their owners and are not globally tracked)."""
