@@ -169,6 +169,11 @@ class GcsServer:
         # object store); feeds /api/node_stats and pid->node routing for
         # the profiler.  Ephemeral by design (like resource views).
         self.node_stats: Dict[str, dict] = {}
+        # Spill/restore counts carried over from DEAD nodes so
+        # spill_totals() stays a true lifetime total (a dead node's live
+        # stats entry is dropped below).
+        self._dead_spill_totals = {"spilled_objects": 0,
+                                   "restored_objects": 0}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
         self._health_task: Optional[asyncio.Task] = None
@@ -356,6 +361,12 @@ class GcsServer:
         return None
 
     async def _h_get_node_stats(self, conn, msg):
+        if any(self._dead_spill_totals.values()):
+            # synthetic record: keeps spill_totals() a lifetime sum
+            # across node deaths; carries no workers, so pid routing and
+            # the dashboard worker table ignore it
+            return {**self.node_stats,
+                    "__dead_nodes__": dict(self._dead_spill_totals)}
         return self.node_stats
 
     async def _h_profile_worker(self, conn, msg):
@@ -539,8 +550,12 @@ class GcsServer:
             return
         node.alive = False
         # Drop its stats report: dead-node workers must neither linger in
-        # the dashboard nor shadow reused pids in profile routing.
-        self.node_stats.pop(node.node_id.hex(), None)
+        # the dashboard nor shadow reused pids in profile routing — but
+        # fold its spill counters into the lifetime carry-over first.
+        dropped = self.node_stats.pop(node.node_id.hex(), None)
+        if dropped:
+            for k in self._dead_spill_totals:
+                self._dead_spill_totals[k] += dropped.get(k, 0)
         await self._publish("nodes", {"event": "dead", "node": node.public()})
         # Restart or kill actors that lived on this node.
         for actor in list(self.actors.values()):

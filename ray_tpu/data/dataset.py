@@ -567,10 +567,11 @@ class Dataset:
         try:
             from ray_tpu.util.state import spill_totals
             t = spill_totals()
-            lines.append(
-                f"Cluster objects spilled: {t['spilled_objects']}, "
-                f"restored: {t['restored_objects']} "
-                f"(lifetime totals; node stats refresh ~2s)")
+            if t["spilled_objects"] or t["restored_objects"]:
+                lines.append(
+                    f"Cluster objects spilled: {t['spilled_objects']}, "
+                    f"restored: {t['restored_objects']} "
+                    f"(lifetime totals; node stats refresh ~2s)")
         except Exception:
             pass   # stats channel unavailable (e.g. local_mode)
         return "\n".join(lines)
