@@ -32,6 +32,15 @@ class RtConfig:
     # -- control plane --
     heartbeat_period_s: float = 0.5
     health_timeout_s: float = 15.0          # missed-heartbeat death window
+    # Cap on the lag-grace term added to health_timeout_s when the GCS's
+    # own loop (or the node's, per its heartbeats) recently stalled: a
+    # stalled control plane must not misread its own lag as node death,
+    # but unbounded grace would mask genuinely dead nodes forever.
+    health_lag_grace_max_s: float = 30.0
+    # Event-loop watchdog (raylet + GCS): probe cadence and the stall
+    # size that logs a warning with the offending-callback hint.
+    loop_watchdog_interval_s: float = 0.25
+    loop_watchdog_warn_s: float = 1.0
     gcs_snapshot_period_s: float = 1.0
     node_view_cache_s: float = 0.5          # spill/SPREAD scoring staleness
     task_event_retention: int = 20000
@@ -40,6 +49,12 @@ class RtConfig:
     idle_worker_cap_per_shape: int = 8
     worker_start_timeout_s: float = 120.0
     lease_request_timeout_s: float = 600.0
+    # -- forkserver (all deadlines are per-step, never block the loop) --
+    forkserver_connect_timeout_s: float = 1.0   # unix connect deadline
+    forkserver_spawn_timeout_s: float = 5.0     # request->pid reply deadline
+    forkserver_boot_grace_s: float = 15.0       # template bind-or-bad window
+    forkserver_backoff_base_s: float = 0.5      # template restart backoff
+    forkserver_backoff_max_s: float = 30.0
     # -- memory management --
     spill_high_water: float = 0.8
     spill_low_water: float = 0.6
@@ -97,6 +112,14 @@ def config() -> RtConfig:
     if _config is None:
         _config = RtConfig._from_env()
     return _config
+
+
+def reset_config() -> None:
+    """Drop the cached config so the next config() re-reads the
+    environment.  Test hook: lets monkeypatched RT_* env vars take
+    effect inside an already-imported process."""
+    global _config
+    _config = None
 
 
 def apply_system_config(overrides: Optional[Dict[str, Any]]):

@@ -751,14 +751,30 @@ class CoreWorker:
             # Don't pre-clear sibling entries: a failed resubmit must leave
             # healthy siblings resolvable, and a successful one overwrites
             # the stale 'plasma' entries anyway.
-            try:
-                reply = await self._submit_once(rec["spec"], rec["resources"],
-                                                rec["scheduling"])
-                ok = bool(reply.get("ok"))
-                if ok:
-                    self._store_task_returns(reply, rec["return_ids"])
-            except Exception:
-                ok = False
+            #
+            # The resubmit consumes the task's own retry budget (reference:
+            # lineage reconstruction decrements num_retries_left).  The
+            # first attempt often races the very node death that triggered
+            # reconstruction — cluster views are stale for up to a
+            # heartbeat, so the lease can chase the dead raylet and get
+            # ECONNREFUSED — hence the short backoff between attempts.
+            ok = False
+            attempts = 1 + max(0, int(rec.get("max_retries", 0)))
+            for attempt in range(attempts):
+                if attempt:
+                    await asyncio.sleep(min(2.0, 0.5 * (2 ** (attempt - 1))))
+                try:
+                    reply = await self._submit_once(
+                        rec["spec"], rec["resources"], rec["scheduling"])
+                    ok = bool(reply.get("ok"))
+                    if ok:
+                        self._store_task_returns(reply, rec["return_ids"])
+                        break
+                except Exception as e:
+                    logger.warning(
+                        "reconstruction of %s via task %s failed "
+                        "(attempt %d/%d): %r", oid_hex[:16],
+                        rec["spec"]["name"], attempt + 1, attempts, e)
             fut.set_result(ok)
             return ok
         finally:
@@ -1070,7 +1086,7 @@ class CoreWorker:
             self._lineage[oid.hex()] = {
                 "spec": spec, "resources": resources,
                 "scheduling": scheduling, "return_ids": return_ids,
-                "pins": pinned_args,
+                "pins": pinned_args, "max_retries": max_retries,
             }
         # Cancellation registry (reference core_worker.cc CancelTask):
         # tracks the submission's asyncio task (pending-phase cancel) and

@@ -63,6 +63,11 @@ class NodeInfo:
     is_head: bool = False
     # Unsatisfied lease shapes last reported by the raylet (autoscaler input).
     pending_demand: List[Dict[str, float]] = field(default_factory=list)
+    # Daemon process pid (chaos tooling: util/fault_injection NodeKiller).
+    pid: int = 0
+    # Worst recent event-loop lag the raylet reported with its last
+    # heartbeat (seconds); feeds the per-node health grace.
+    reported_lag_s: float = 0.0
 
     def public(self) -> dict:
         return {
@@ -74,6 +79,7 @@ class NodeInfo:
             "labels": self.labels,
             "alive": self.alive,
             "is_head": self.is_head,
+            "pid": self.pid,
         }
 
 
@@ -171,11 +177,16 @@ class GcsServer:
         self.node_stats: Dict[str, dict] = {}
         # Spill/restore counts carried over from DEAD nodes so
         # spill_totals() stays a true lifetime total (a dead node's live
-        # stats entry is dropped below).
-        self._dead_spill_totals = {"spilled_objects": 0,
-                                   "restored_objects": 0}
+        # stats entry is dropped below).  Keyed by node id: the raylet
+        # reports LIFETIME counters, so folding the same node twice
+        # (die -> re-register after a transient partition -> die again)
+        # must overwrite its entry, not add to a global sum — and a
+        # re-registration drops the entry outright because the live node
+        # resumes reporting the same lifetime counters itself.
+        self._dead_spill_totals: Dict[str, Dict[str, int]] = {}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
+        self._watchdog = None   # LoopWatchdog, created in start()
         self._health_task: Optional[asyncio.Task] = None
         self._snapshot_task: Optional[asyncio.Task] = None
         self._dirty = False
@@ -185,6 +196,13 @@ class GcsServer:
         if self._persist_path:
             self._load_snapshot()
         port = await self.server.start(port)
+        # The health verdict below compares heartbeat age against a
+        # timeout — but heartbeats are PROCESSED on this loop, so our own
+        # lag inflates every age.  The watchdog measures that lag; the
+        # health check credits it back as grace.
+        from ray_tpu._private.loop_watchdog import LoopWatchdog
+        self._watchdog = LoopWatchdog("gcs")
+        self._watchdog.start()
         self._health_task = asyncio.get_running_loop().create_task(self._health_loop())
         if self._persist_path:
             self._snapshot_task = asyncio.get_running_loop().create_task(
@@ -193,6 +211,8 @@ class GcsServer:
 
     async def close(self):
         self._closing = True
+        if getattr(self, "_watchdog", None) is not None:
+            self._watchdog.stop()
         if self._health_task:
             self._health_task.cancel()
         if self._snapshot_task:
@@ -360,14 +380,22 @@ class GcsServer:
         self.node_stats[msg["node_id"]] = msg["stats"]
         return None
 
+    def dead_spill_totals(self) -> Dict[str, int]:
+        """Aggregate spill/restore counters folded from dead nodes."""
+        totals = {"spilled_objects": 0, "restored_objects": 0}
+        for entry in self._dead_spill_totals.values():
+            for k in totals:
+                totals[k] += entry.get(k, 0)
+        return totals
+
     async def _h_get_node_stats(self, conn, msg):
-        if any(self._dead_spill_totals.values()):
-            # synthetic record: keeps spill_totals() a lifetime sum
-            # across node deaths; carries no workers, so pid routing and
-            # the dashboard worker table ignore it
-            return {**self.node_stats,
-                    "__dead_nodes__": dict(self._dead_spill_totals)}
-        return self.node_stats
+        # "nodes" is the live per-node map; "dead_totals" carries the
+        # lifetime spill/restore counters of dead nodes as an explicit
+        # field (it used to ride inside the map under a synthetic
+        # "__dead_nodes__" key, which every consumer had to know to
+        # skip).
+        return {"nodes": self.node_stats,
+                "dead_totals": self.dead_spill_totals()}
 
     async def _h_profile_worker(self, conn, msg):
         """Route a stack-profile request to the raylet hosting ``pid``
@@ -461,8 +489,13 @@ class GcsServer:
             labels=msg.get("labels", {}),
             conn=conn,
             is_head=msg.get("is_head", False),
+            pid=int(msg.get("pid", 0)),
         )
         self.nodes[node.node_id] = node
+        # A node back from a transient partition resumes reporting its own
+        # lifetime spill counters — keeping its folded entry would count
+        # them twice in spill_totals().
+        self._dead_spill_totals.pop(node.node_id.hex(), None)
         await self._publish("nodes", {"event": "alive", "node": node.public()})
         logger.info("node registered: %s at %s", node.node_id, node.address)
         await self._try_schedule_pending()
@@ -476,6 +509,7 @@ class GcsServer:
         if "resources_available" in msg:
             node.resources_available = msg["resources_available"]
         node.pending_demand = msg.get("pending_leases", [])
+        node.reported_lag_s = float(msg.get("loop_lag_ms", 0.0)) / 1000.0
         # Retry queued actors: availability may have just been freed (a
         # worker died / finished).  Without this, an actor that queued
         # during a transient full-node view waits for a *new node
@@ -538,11 +572,25 @@ class GcsServer:
         while True:
             await asyncio.sleep(_heartbeat_period())
             now = time.monotonic()
+            # Grace for OUR lag: if this loop stalled, heartbeats sat
+            # unprocessed in socket buffers and every age below is
+            # inflated by exactly that stall.
+            gcs_lag = (self._watchdog.max_recent_s(_health_timeout())
+                       if self._watchdog is not None else 0.0)
+            cap = _rt_config().health_lag_grace_max_s
             for node in list(self.nodes.values()):
+                # Grace for THEIR lag: a raylet that recently reported a
+                # big stall (spawn storm, /proc scan) earns its lag back.
+                # Both terms are capped — grace forgives transient lag,
+                # never an actually-silent node.
+                grace = min(cap, gcs_lag + node.reported_lag_s)
                 if node.alive and not node.is_head and \
-                        now - node.last_heartbeat > _health_timeout():
-                    logger.warning("node %s missed heartbeats; marking dead",
-                                   node.node_id)
+                        now - node.last_heartbeat > _health_timeout() + grace:
+                    logger.warning(
+                        "node %s missed heartbeats for %.1fs (timeout "
+                        "%.1fs + lag grace %.1fs); marking dead",
+                        node.node_id, now - node.last_heartbeat,
+                        _health_timeout(), grace)
                     await self._mark_node_dead(node)
 
     async def _mark_node_dead(self, node: NodeInfo):
@@ -554,8 +602,12 @@ class GcsServer:
         # fold its spill counters into the lifetime carry-over first.
         dropped = self.node_stats.pop(node.node_id.hex(), None)
         if dropped:
-            for k in self._dead_spill_totals:
-                self._dead_spill_totals[k] += dropped.get(k, 0)
+            # Overwrite (not +=): the counters are lifetime totals, so a
+            # node that died before with the same id replaces its entry.
+            self._dead_spill_totals[node.node_id.hex()] = {
+                "spilled_objects": dropped.get("spilled_objects", 0),
+                "restored_objects": dropped.get("restored_objects", 0),
+            }
         await self._publish("nodes", {"event": "dead", "node": node.public()})
         # Restart or kill actors that lived on this node.
         for actor in list(self.actors.values()):

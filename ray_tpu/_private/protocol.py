@@ -35,6 +35,39 @@ class ConnectionLost(Exception):
     pass
 
 
+# Fault-injection shim (chaos testing; see util/fault_injection.py):
+# when installed, the filter sees every outgoing frame BEFORE it reaches
+# the transport and returning True silently drops it — modeling a lossy
+# or half-partitioned link deterministically.  Module-level so one
+# install covers every connection in the process; activated either
+# directly by tests (set_frame_fault) or via the RT_FAULT_INJECTION env
+# "drop_rpc" spec on daemon startup.
+_frame_fault: Optional[Callable[["RpcConnection", bytes], bool]] = None
+_env_fault_checked = False
+
+
+def set_frame_fault(
+        fn: Optional[Callable[["RpcConnection", bytes], bool]]) -> None:
+    """Install (or clear, with None) the outgoing-frame drop filter."""
+    global _frame_fault
+    _frame_fault = fn
+
+
+def _maybe_install_env_fault() -> None:
+    global _env_fault_checked, _frame_fault
+    if _env_fault_checked:
+        return
+    _env_fault_checked = True
+    import os
+    if "RT_FAULT_INJECTION" not in os.environ:
+        return
+    from ray_tpu.util import fault_injection
+    drop = fault_injection.spec().drop_rpc
+    if drop:
+        _frame_fault = fault_injection.make_drop_filter(
+            drop.get("conn", ""), int(drop.get("every", 0)))
+
+
 class RpcConnection:
     """A duplex request/reply + notify channel over one stream.
 
@@ -66,6 +99,7 @@ class RpcConnection:
         # instead of a frame each.  Bulk payloads (chunk transfer) bypass
         # it via _send_frame so megabytes never sit in a Python list.
         self._outbox: list = []
+        _maybe_install_env_fault()
 
     def start(self):
         self._serve_task = asyncio.get_running_loop().create_task(self._serve())
@@ -83,6 +117,8 @@ class RpcConnection:
         # frames write separately to avoid copying megabytes per frame.
         # Backpressure still applies: drain once >=1MB is outstanding since
         # the last drain (bulk chunk transfers hit this every frame).
+        if _frame_fault is not None and _frame_fault(self, payload):
+            return
         if len(payload) < 65536:
             self.writer.write(_HEADER.pack(len(payload)) + payload)
         else:
@@ -99,6 +135,8 @@ class RpcConnection:
         suspend (batch send / inline replies).  Same coalescing as
         _send_frame; over the backpressure threshold it schedules a drain
         task instead of awaiting one."""
+        if _frame_fault is not None and _frame_fault(self, payload):
+            return
         if len(payload) < 65536:
             self.writer.write(_HEADER.pack(len(payload)) + payload)
         else:
@@ -312,8 +350,12 @@ class RpcConnection:
             except asyncio.CancelledError:
                 # Distinguish "serve task cancelled" (expected) from
                 # "close() itself is being cancelled" (must propagate).
+                # Task.cancelling() exists only on 3.11+; on older
+                # runtimes swallow the cancellation (pre-refinement
+                # behavior) rather than crash every close().
                 cur = asyncio.current_task()
-                if cur is not None and cur.cancelling() > 0:
+                if cur is not None and \
+                        getattr(cur, "cancelling", lambda: 0)() > 0:
                     raise
             except Exception:
                 pass

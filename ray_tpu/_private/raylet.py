@@ -172,6 +172,8 @@ class Raylet:
             f"/tmp/rtfs-{node_id.hex()[:12]}.sock",
             os.path.join(self.log_dir, "forkserver.log")) \
             if os.environ.get("RT_DISABLE_FORKSERVER") != "1" else None
+        # Event-loop lag probe (started in start(); see loop_watchdog.py).
+        self._watchdog = None
 
     def _num_idle(self) -> int:
         return sum(len(v) for v in self.idle_workers.values())
@@ -190,7 +192,16 @@ class Raylet:
             "resources": self.resources_total,
             "labels": self.labels,
             "is_head": self.is_head,
+            # Daemon pid: lets chaos tooling (util/fault_injection
+            # NodeKiller) target this node without out-of-band plumbing.
+            "pid": os.getpid(),
         })
+        # Liveness self-measurement: heartbeats ride this same loop, so
+        # its lag IS the heartbeat delay (exported via node stats and
+        # attached to each heartbeat for the GCS's health grace).
+        from ray_tpu._private.loop_watchdog import LoopWatchdog
+        self._watchdog = LoopWatchdog(f"raylet-{self.node_id.hex()[:8]}")
+        self._tasks.append(self._watchdog.start())
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._heartbeat_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(
@@ -223,6 +234,8 @@ class Raylet:
 
     async def close(self):
         self._shutdown = True
+        if self._watchdog is not None:
+            self._watchdog.stop()
         for t in self._tasks:
             t.cancel()
         for w in list(self.workers.values()):
@@ -323,7 +336,7 @@ class Raylet:
                      "num_evictions": st.get("num_evictions")}
         except Exception:
             pass
-        return {
+        out = {
             "timestamp": time.time(),
             "load_avg": [load1, load5, load15],
             "mem_total": mem.get("MemTotal"),
@@ -334,6 +347,9 @@ class Raylet:
             "spilled_objects": self._spilled_objects,
             "restored_objects": self._restored_objects,
         }
+        if self._watchdog is not None:
+            out.update(self._watchdog.record())
+        return out
 
     def _purge_dead_leases(self) -> None:
         """Drop leases whose futures are done (caller cancelled / errored)
@@ -353,7 +369,9 @@ class Raylet:
 
     async def _stuck_lease_watchdog(self):
         """Log scheduler state while leases sit queued — a queued lease
-        with idle capacity means resource accounting has leaked."""
+        with idle capacity means resource accounting leaked or a dispatch
+        trigger was missed.  Then re-run dispatch: a missed trigger must
+        cost one watchdog period, not hang the lease forever."""
         while not self._shutdown:
             await asyncio.sleep(20)
             self._purge_dead_leases()
@@ -366,10 +384,21 @@ class Raylet:
                     busy, self._num_idle(), len(self.workers),
                     [r.resources for r in
                      itertools.islice(self._pending_iter(), 4)])
+                try:
+                    await self._dispatch_leases()
+                except Exception:
+                    logger.exception("stuck-lease redispatch failed")
 
     async def _heartbeat_loop(self):
+        from ray_tpu.util import fault_injection
         while not self._shutdown:
             try:
+                # Chaos hook: a test can stretch this node's heartbeat
+                # period to prove the GCS death verdict fires on real
+                # heartbeat silence (and only on it).
+                delay = fault_injection.heartbeat_delay_s()
+                if delay > 0:
+                    await asyncio.sleep(delay)
                 await self.gcs_conn.request({
                     "type": "heartbeat",
                     "node_id": self.node_id.hex(),
@@ -380,6 +409,13 @@ class Raylet:
                     "pending_leases": [
                         r.resources for r in
                         itertools.islice(self._pending_iter(), 100)],
+                    # Recent worst loop lag: the GCS folds it into its
+                    # health grace so a node briefly starved by a spawn
+                    # storm is not misdeclared dead.
+                    "loop_lag_ms": (
+                        self._watchdog.max_recent_s(
+                            config().health_timeout_s) * 1000.0
+                        if self._watchdog is not None else 0.0),
                 })
             except Exception:
                 pass
@@ -432,6 +468,14 @@ class Raylet:
                     if pg_id else self.resources_available
                 for k, v in resources.items():
                     pool[k] = pool.get(k, 0.0) + v
+                # A lease queued while this actor still held its resources
+                # has no later wake-up — kill_actor_worker only signals the
+                # process, so the reap here IS the resource release, and
+                # without a dispatch the lease waits forever on a node with
+                # free capacity.
+                if self.pending_leases:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch_leases())
             # Only report deaths of actors that finished creation.  A worker
             # dying mid-create already fails the pending create_actor_worker
             # request — a duplicate death report would race the GCS's
@@ -509,10 +553,10 @@ class Raylet:
 
     # ------------------------------------------------------------ workers
 
-    def _spawn_worker(self, actor_id: Optional[str] = None,
-                      runtime_env: Optional[dict] = None,
-                      env_key: str = "",
-                      job_id: Optional[str] = None) -> WorkerHandle:
+    async def _spawn_worker(self, actor_id: Optional[str] = None,
+                            runtime_env: Optional[dict] = None,
+                            env_key: str = "",
+                            job_id: Optional[str] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -546,20 +590,31 @@ class Raylet:
         if self._forkserver is not None and env.get("JAX_PLATFORMS") == "cpu":
             # CPU workers fork from the warm template (~20ms, CoW pages);
             # TPU workers need a cold interpreter for PJRT registration.
-            proc = self._forkserver.spawn(env, out_path, err_path)
+            # Asynchronous with per-step deadlines: a wedged template
+            # costs this spawn its deadline, never the event loop.
+            proc = await self._forkserver.spawn(env, out_path, err_path)
         if proc is None:
-            out_f = open(out_path, "ab", buffering=0)
-            err_f = open(err_path, "ab", buffering=0)
-            try:
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                    env=env,
-                    stdout=out_f,
-                    stderr=err_f,
-                )
-            finally:
-                out_f.close()
-                err_f.close()
+            # Cold fallback off-loop: Popen's fork+exec plus the log-file
+            # opens are milliseconds of syscalls, but under a spawn storm
+            # dozens of them back-to-back would add up to missed
+            # heartbeats — the executor keeps the loop free.
+            def _cold_spawn():
+                out_f = open(out_path, "ab", buffering=0)
+                err_f = open(err_path, "ab", buffering=0)
+                try:
+                    return subprocess.Popen(
+                        [sys.executable, "-m",
+                         "ray_tpu._private.worker_main"],
+                        env=env,
+                        stdout=out_f,
+                        stderr=err_f,
+                    )
+                finally:
+                    out_f.close()
+                    err_f.close()
+
+            proc = await asyncio.get_running_loop().run_in_executor(
+                None, _cold_spawn)
         w = WorkerHandle(worker_id=worker_id, proc=proc, actor_id=actor_id,
                          env_key=env_key,
                          ready=asyncio.get_running_loop().create_future())
@@ -579,7 +634,8 @@ class Raylet:
             if w.proc.poll() is None:
                 return w
             await self._on_worker_death(w)
-        w = self._spawn_worker(runtime_env=runtime_env, env_key=env_key)
+        w = await self._spawn_worker(runtime_env=runtime_env,
+                                     env_key=env_key)
         await asyncio.wait_for(w.ready, timeout=config().worker_start_timeout_s)
         return w
 
@@ -596,9 +652,9 @@ class Raylet:
             pool[k] = pool.get(k, 0.0) - v
         w = None
         try:
-            w = self._spawn_worker(actor_id=msg["actor_id"],
-                                   runtime_env=msg.get("runtime_env"),
-                                   job_id=msg.get("job_id"))
+            w = await self._spawn_worker(actor_id=msg["actor_id"],
+                                         runtime_env=msg.get("runtime_env"),
+                                         job_id=msg.get("job_id"))
             w.actor_resources = (resources, pg_id, msg.get("bundle_index", 0))
             logger.debug("actor %s: spawned worker %s pid=%s, waiting ready",
                          msg["actor_id"][:8], w.worker_id.hex()[:8],

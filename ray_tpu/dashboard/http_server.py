@@ -191,12 +191,30 @@ class DashboardHttpServer:
         for k, v in s["resources"]["available"].items():
             lines.append(f'ray_tpu_resource_available'
                          f'{{resource="{_escape_label(k)}"}} {v}')
+        # Control-plane liveness: event-loop lag of the GCS (its own
+        # watchdog, in-process) and of every raylet (ridden in over node
+        # stats).  Rendered through the shared exposition renderer with
+        # the built-in prefix — these are system series, not user metrics.
+        lag_records = []
+        wd = getattr(self.gcs, "_watchdog", None)
+        if wd is not None:
+            lag_records.append({
+                "name": "loop_lag_ms", "type": "gauge",
+                "labels": {"component": "gcs"}, "value": wd.last_lag_ms})
+        for node_id, st in self.gcs.node_stats.items():
+            if "loop_lag_ms" in st:
+                lag_records.append({
+                    "name": "loop_lag_ms", "type": "gauge",
+                    "labels": {"component": "raylet",
+                               "node_id": node_id},
+                    "value": st["loop_lag_ms"]})
         # User metrics: reuse the GCS's (name, labels) aggregation and the
         # shared exposition renderer (which sanitizes names) — per-process
         # raw records would emit duplicate series and drop histogram
         # buckets, and any per-endpoint renaming would give one metric two
         # series names depending on scrape point.
         return "\n".join(lines) + "\n" + \
+            render_prometheus(lag_records, prefix="ray_tpu_") + \
             render_prometheus(self.gcs.aggregated_metrics())
 
 

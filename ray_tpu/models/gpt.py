@@ -411,7 +411,20 @@ def blocked_ce_loglike_sum(x: jax.Array, head: jax.Array,
     if S % block or S == block:
         # Non-dividing block: one full-sequence chunk under checkpoint
         # would cost the recompute with zero memory benefit — use the
-        # plain fused loss instead.
+        # plain fused loss instead.  That silently materializes the full
+        # [B, S, V] logits the caller configured ce_block to avoid, so
+        # say it loudly (this branch runs at trace time, once per shape)
+        # — or refuse outright under RT_STRICT_CE_BLOCK=1.
+        import os
+        msg = (f"ce_block={block} does not evenly split sequence length "
+               f"S={S} into multiple chunks; falling back to full "
+               f"[B={B}, S={S}, V] logits — the blocked head's memory "
+               f"win is LOST. Pick ce_block so that S % ce_block == 0 "
+               f"and ce_block < S.")
+        if os.environ.get("RT_STRICT_CE_BLOCK") == "1":
+            raise ValueError(msg)
+        import warnings
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
         full_eq = "bsd,vd->bsv" if head_layout == "vd" else "bsd,dv->bsv"
         return jnp.sum(token_loglikes(jnp.einsum(full_eq, x, head),
                                       targets))

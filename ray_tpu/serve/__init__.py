@@ -205,6 +205,27 @@ def http_addresses() -> List[str]:
     return sorted(urls)
 
 
+def _wait_name_free(name: str, core, timeout: float = 30.0) -> bool:
+    """Block until a detached-actor name is free in the GCS.
+
+    ``get_named_actor`` already filters DEAD actors, so the name is free
+    as soon as the kill lands.  Returns False on timeout (callers proceed
+    anyway — the retry then fails loudly instead of silently hanging)."""
+    from ray_tpu._private.worker import global_worker
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            rec = core.gcs_request({"type": "get_named_actor",
+                                    "name": name,
+                                    "namespace": global_worker.namespace})
+        except Exception:
+            return True     # GCS gone — nothing to conflict with
+        if rec is None:
+            return True
+        time.sleep(0.1)
+    return False
+
+
 def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
     from ray_tpu._private.worker import get_core, global_worker
     from ray_tpu.serve.http_ingress import HTTPIngress
@@ -241,6 +262,11 @@ def _start_ingresses(host: str, port: int, per_node: bool) -> List[str]:
                 # fails the retry propagates below
                 last_err = e
                 ray_tpu.kill(ingress)
+                # kill() is async on the GCS side: until the DEAD state
+                # lands, get_if_exists on the retry would hand back the
+                # DYING actor and the ephemeral-port attempt would time
+                # out against it.  Wait for the name to actually free.
+                _wait_name_free(name, get_core(), timeout=30)
         if addr is None:
             raise RuntimeError(
                 f"serve ingress {name} failed to start") from last_err

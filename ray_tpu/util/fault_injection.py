@@ -1,0 +1,211 @@
+"""Deterministic fault injection for chaos testing.
+
+Reference analog: ``python/ray/_private/test_utils.py`` NodeKillerActor
+(:1346) — the reference treats failure injection as a first-class,
+reusable API so chaos tests gate their assertions on *observed* cluster
+state (death recorded, recovery complete) instead of ad-hoc process
+kills plus wall-clock sleeps.
+
+Two halves:
+
+* **Process-local hooks.**  A JSON spec in the ``RT_FAULT_INJECTION``
+  env var, parsed once per process.  Daemons consult it at exactly three
+  injection points: the forkserver template serve loop (``"forkserver":
+  "wedge"`` accepts connections and never replies; ``{"mode": "slow",
+  "delay_s": X}`` replies late), the raylet heartbeat loop
+  (``"heartbeat_delay_s": X`` stretches the period), and the RPC frame
+  send path (``"drop_rpc": {"conn": <name substring>, "every": N}``
+  silently drops every Nth outgoing frame on matching connections —
+  see ``protocol.set_frame_fault``).  Start ONE node of a test cluster
+  with ``env=env_for(...)`` to fault just that node.
+
+* **NodeKiller.**  Kills node daemons by the pid each raylet registers
+  with the GCS, then waits for the GCS to record the death.  Usable
+  directly in a driver or as an actor via ``ray_tpu.remote(NodeKiller)``.
+
+Everything here is import-light (stdlib only at module load) because the
+forkserver template and the protocol layer import it inside daemons.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_VAR = "RT_FAULT_INJECTION"
+
+
+@dataclass
+class FaultSpec:
+    forkserver: Optional[Any] = None     # "wedge" | {"mode","delay_s"}
+    heartbeat_delay_s: float = 0.0
+    drop_rpc: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_env(cls) -> "FaultSpec":
+        blob = os.environ.get(ENV_VAR)
+        if not blob:
+            return cls()
+        try:
+            raw = json.loads(blob)
+        except (json.JSONDecodeError, TypeError):
+            return cls()
+        return cls(
+            forkserver=raw.get("forkserver"),
+            heartbeat_delay_s=float(raw.get("heartbeat_delay_s", 0.0)),
+            drop_rpc=raw.get("drop_rpc"),
+        )
+
+
+_spec_cache: Optional[FaultSpec] = None
+
+
+def spec() -> FaultSpec:
+    """The process's active fault spec (cached env parse)."""
+    global _spec_cache
+    if _spec_cache is None:
+        _spec_cache = FaultSpec.from_env()
+    return _spec_cache
+
+
+def set_spec(**kwargs) -> FaultSpec:
+    """In-process override for unit tests (does not touch the env, so
+    subprocesses are unaffected).  Pair with clear_spec()."""
+    global _spec_cache
+    _spec_cache = FaultSpec(**kwargs)
+    return _spec_cache
+
+
+def clear_spec() -> None:
+    global _spec_cache
+    _spec_cache = None
+
+
+def env_for(**kwargs) -> Dict[str, str]:
+    """Env fragment that activates the given faults in a subprocess:
+    ``Cluster.add_node(env=fault_injection.env_for(forkserver="wedge"))``."""
+    return {ENV_VAR: json.dumps(kwargs)}
+
+
+def forkserver_fault() -> Tuple[str, float]:
+    """(mode, delay_s) for the forkserver template serve loop."""
+    fs = spec().forkserver
+    if not fs:
+        return "", 0.0
+    if isinstance(fs, str):
+        return fs, 0.0
+    return fs.get("mode", ""), float(fs.get("delay_s", 0.0))
+
+
+def heartbeat_delay_s() -> float:
+    """Extra delay injected before each raylet heartbeat."""
+    return spec().heartbeat_delay_s
+
+
+def make_drop_filter(conn_substr: str, every: int):
+    """Frame filter for ``protocol.set_frame_fault``: drops every
+    ``every``-th outgoing frame on connections whose name contains
+    ``conn_substr``.  Deterministic: per-connection counters."""
+    counts: Dict[int, int] = {}
+
+    def _filter(conn, payload: bytes) -> bool:
+        if conn_substr not in (conn.name or ""):
+            return False
+        n = counts.get(id(conn), 0) + 1
+        counts[id(conn)] = n
+        return every > 0 and n % every == 0
+
+    return _filter
+
+
+# --------------------------------------------------------------- observers
+
+def _list_nodes() -> List[dict]:
+    from ray_tpu.util import state
+    return state.list_nodes()
+
+
+def wait_node_dead(node_id: str, timeout: float = 120.0) -> dict:
+    """Block until the GCS records ``node_id`` as dead; returns its node
+    record.  This is the recovery gate chaos tests key on — wall-clock
+    sleeps race the health timeout, observed state does not.  Transient
+    query errors (a GCS briefly saturated on a loaded box) are retried
+    until the deadline, not propagated."""
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            for n in _list_nodes():
+                if n["node_id"] == node_id and not n["alive"]:
+                    return n
+            last_err = None
+        except Exception as e:
+            last_err = e
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"node {node_id[:12]} not marked dead within {timeout}s"
+        + (f" (last query error: {last_err!r})" if last_err else ""))
+
+
+def wait_alive_nodes(count: int, timeout: float = 120.0) -> List[dict]:
+    """Block until exactly ``count`` nodes are alive per the GCS."""
+    deadline = time.monotonic() + timeout
+    alive: List[dict] = []
+    while time.monotonic() < deadline:
+        try:
+            alive = [n for n in _list_nodes() if n["alive"]]
+        except Exception:
+            alive = []
+        if len(alive) == count:
+            return alive
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"expected {count} alive nodes within {timeout}s, have "
+        f"{len(alive)}")
+
+
+class NodeKiller:
+    """Kills node daemons and waits for the GCS to observe the death.
+
+    Plain class so a driver can use it inline; wrap with
+    ``ray_tpu.remote(NodeKiller)`` to run it inside the cluster like the
+    reference NodeKillerActor (same-host clusters only: the kill is an
+    ``os.kill`` of the daemon pid the raylet registered)."""
+
+    def __init__(self):
+        self.killed: List[dict] = []
+
+    def alive_nodes(self, exclude_head: bool = True) -> List[dict]:
+        return [n for n in _list_nodes()
+                if n["alive"] and not (exclude_head and n.get("is_head"))]
+
+    def kill_node(self, node_id: Optional[str] = None, *,
+                  exclude_head: bool = True, wait: bool = True,
+                  timeout: float = 120.0) -> dict:
+        """SIGKILL the daemon of ``node_id`` (or the first live non-head
+        node).  With ``wait`` (default), returns only after the GCS has
+        marked the node dead — the caller can immediately assert on
+        recovery behavior without racing the health check."""
+        victims = self.alive_nodes(exclude_head=exclude_head)
+        if node_id is not None:
+            victims = [n for n in victims if n["node_id"] == node_id]
+        victims = [n for n in victims if n.get("pid")]
+        if not victims:
+            raise RuntimeError(
+                f"no killable node (node_id={node_id}, "
+                f"exclude_head={exclude_head})")
+        victim = victims[0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        record = {"node_id": victim["node_id"], "pid": victim["pid"],
+                  "time": time.time()}
+        self.killed.append(record)
+        if wait:
+            wait_node_dead(victim["node_id"], timeout=timeout)
+        return record
+
+    def killed_nodes(self) -> List[dict]:
+        return list(self.killed)

@@ -42,12 +42,20 @@ def list_tasks(limit: int = 20000, *, offset: int = 0,
                          "kind": kind, "trace_id": trace_id})
 
 
+def node_stats() -> Dict[str, Dict[str, Any]]:
+    """Latest per-node agent report (workers, load, memory, object store,
+    ``loop_lag_ms``) keyed by node id.  Dead nodes' lifetime spill
+    counters arrive separately in the RPC's ``dead_totals`` field — use
+    spill_totals() for the cluster-wide lifetime sum."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    return reply.get("nodes", {})
+
+
 def list_workers() -> List[Dict[str, Any]]:
     """Per-node worker processes (pid, cpu, rss, role) from the raylet
     stats stream (reference: `ray list workers` over per-node agents)."""
-    stats = _gcs_request({"type": "get_node_stats"}) or {}
     out: List[Dict[str, Any]] = []
-    for node_id, s in stats.items():
+    for node_id, s in node_stats().items():
         for w in s.get("workers", []):
             out.append({"node_id": node_id, **w})
     return out
@@ -56,12 +64,15 @@ def list_workers() -> List[Dict[str, Any]]:
 def spill_totals() -> Dict[str, int]:
     """Cluster-wide lifetime spill/restore object counts, summed over the
     raylets' periodic stats pushes (refresh interval ~2s, so totals lag
-    live activity by up to one push)."""
-    stats = _gcs_request({"type": "get_node_stats"}) or {}
-    return {"spilled_objects": sum(s.get("spilled_objects", 0)
-                                   for s in stats.values()),
-            "restored_objects": sum(s.get("restored_objects", 0)
-                                    for s in stats.values())}
+    live activity by up to one push).  Includes counters carried over
+    from dead nodes (the GCS's ``dead_totals`` field)."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    stats = reply.get("nodes", {})
+    dead = reply.get("dead_totals", {})
+    return {"spilled_objects": dead.get("spilled_objects", 0) +
+            sum(s.get("spilled_objects", 0) for s in stats.values()),
+            "restored_objects": dead.get("restored_objects", 0) +
+            sum(s.get("restored_objects", 0) for s in stats.values())}
 
 
 def list_objects() -> List[Dict[str, Any]]:

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Chaos gate: run the fault-injection suite 3x back-to-back under CPU
+# load and fail on ANY flake.  A chaos test that passes once proves the
+# happy path; one that passes three times on a saturated box proves the
+# recovery gates actually gate (wall-clock-sleep "synchronization" is
+# exactly what load exposes).
+#
+# Usage: scripts/run_chaos.sh [extra pytest args...]
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+RUNS="${CHAOS_RUNS:-3}"
+BURNERS="${CHAOS_BURNERS:-$((2 * $(nproc)))}"
+
+echo "chaos gate: ${RUNS} runs, ${BURNERS} nice'd CPU burners"
+
+burner_pids=()
+for _ in $(seq "$BURNERS"); do
+    nice -n 19 python -c 'while True: pass' >/dev/null 2>&1 &
+    burner_pids+=("$!")
+done
+cleanup() {
+    kill "${burner_pids[@]}" 2>/dev/null
+    wait "${burner_pids[@]}" 2>/dev/null
+}
+trap cleanup EXIT
+
+fail=0
+for i in $(seq "$RUNS"); do
+    echo "=== chaos run ${i}/${RUNS} ==="
+    if ! JAX_PLATFORMS=cpu timeout -k 10 900 \
+        python -m pytest tests/ -q -m chaos \
+        -p no:cacheprovider -p no:randomly "$@"; then
+        echo "=== chaos run ${i}/${RUNS}: FAILED ==="
+        fail=1
+        break
+    fi
+done
+
+if [ "$fail" -ne 0 ]; then
+    echo "chaos gate: FLAKY (failed within ${RUNS} runs)"
+    exit 1
+fi
+echo "chaos gate: ${RUNS}/${RUNS} clean"

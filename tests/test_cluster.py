@@ -76,15 +76,24 @@ def test_actor_node_death_restart(cluster):
     assert ray_tpu.get(a.node.remote()) == n2.node_id
 
     cluster.remove_node(n2)  # hard kill; "doomed" now exists nowhere
+    # Recovery gate: wait for the GCS to record the death.  The killed
+    # node's actor worker lingers up to ~1s (it self-exits when it
+    # notices its raylet is gone), and a call in that window succeeds
+    # against the OLD incarnation — a stale read, not a restart.
+    from ray_tpu.util import fault_injection
+    fault_injection.wait_node_dead(n2.node_id, timeout=60)
     n3 = cluster.add_node(num_cpus=2, resources={"doomed": 1.0})
     deadline = time.monotonic() + 60
     while True:
         try:
             nid = ray_tpu.get(a.node.remote(), timeout=10)
-            break
+            if nid == n3.node_id:
+                break   # served by the restarted incarnation
         except Exception:
-            assert time.monotonic() < deadline, "actor never recovered"
-            time.sleep(0.5)
+            pass
+        assert time.monotonic() < deadline, \
+            f"actor never recovered onto {n3.node_id[:12]}"
+        time.sleep(0.5)
     assert nid == n3.node_id
 
 
@@ -124,5 +133,41 @@ def test_per_node_serve_ingress_fleet(cluster):
                     if time.time() > deadline:
                         raise
                     time.sleep(0.5)
+    finally:
+        serve.shutdown()
+
+
+def test_per_node_ingress_bind_conflict_retries_ephemeral(cluster):
+    """Simulated clusters share one host, so with a FIXED port only one
+    node's ingress can win the bind; the rest must fall back to an
+    ephemeral port.  Regression: the retry used to race the async kill
+    of the conflicted actor — get_if_exists handed back the DYING
+    detached actor and the ephemeral attempt timed out against it."""
+    import socket
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="conflict_echo", route_prefix="/conflict_echo")
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    serve.run(Echo.bind())
+    # pick a port the OS says is free right now
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    try:
+        first = serve.start_http(port=port, per_node=True)
+        urls = serve.http_addresses()
+        n_alive = sum(1 for n in ray_tpu.nodes() if n["alive"])
+        assert len(urls) == n_alive >= 2, urls
+        # exactly one ingress holds the requested port; the conflicted
+        # one recovered onto a distinct ephemeral port
+        ports = sorted(int(u.rsplit(":", 1)[1]) for u in urls)
+        assert ports.count(port) == 1, (port, urls)
+        assert len(set(ports)) == len(ports), urls
+        assert first in urls
     finally:
         serve.shutdown()

@@ -71,6 +71,48 @@ def test_dashboard_endpoints(dash_cluster):
     assert status == 404
 
 
+def test_loop_lag_exported_and_bounded(dash_cluster):
+    """Control-plane liveness observability: the GCS's and every
+    raylet's event-loop lag must be exported as ``loop_lag_ms`` in
+    /api/metrics AND in the node-stats state API, and a healthy idle
+    cluster's lag must be far below the health timeout."""
+    from ray_tpu._private.config import config
+    from ray_tpu.util import state
+    base = dash_cluster.get("dashboard_address")
+
+    deadline = time.monotonic() + 30
+    while True:
+        _, body = _get(base, "/api/metrics")
+        text = body.decode()
+        has_gcs = 'ray_tpu_loop_lag_ms{component="gcs"}' in text
+        has_raylet = ('ray_tpu_loop_lag_ms{component="raylet"' in text)
+        if has_gcs and has_raylet:
+            break
+        assert time.monotonic() < deadline, \
+            f"loop_lag_ms series missing from /api/metrics:\n{text}"
+        time.sleep(0.5)
+
+    lag_values = [float(line.rsplit(" ", 1)[1])
+                  for line in text.splitlines()
+                  if line.startswith("ray_tpu_loop_lag_ms{")]
+    limit_ms = config().health_timeout_s * 1000
+    assert lag_values, "no loop_lag_ms samples"
+    assert all(0 <= v < limit_ms for v in lag_values), lag_values
+
+    # same signal through the state API, per node
+    deadline = time.monotonic() + 30
+    while True:
+        stats = state.node_stats()
+        if stats and all("loop_lag_ms" in s for s in stats.values()):
+            break
+        assert time.monotonic() < deadline, \
+            f"loop_lag_ms missing from node stats: {stats}"
+        time.sleep(0.5)
+    for s in stats.values():
+        assert 0 <= s["loop_lag_ms"] < limit_ms
+        assert 0 <= s["loop_lag_max_ms"] < limit_ms
+
+
 def test_dashboard_jobs_listing(dash_cluster):
     base = dash_cluster.get("dashboard_address")
     _, body = _get(base, "/api/jobs")

@@ -62,12 +62,12 @@ def test_client_stale_socket_and_fallback(tmp_path):
     client = ForkserverClient(sock, str(tmp_path / "fs.log"))
     try:
         # _ensure unlinks the stale path and starts a real template.
-        # Template boot (full ray_tpu import) can exceed the 2s grace on
-        # a loaded box — retry a few times; a boot-in-progress spawn
-        # returning None is the documented fallback, not a failure.
+        # Template boot (full ray_tpu import) takes seconds on a loaded
+        # box — retry a few times; a boot-in-progress spawn returning
+        # None is the documented fallback, not a failure.
         proc = None
         for _ in range(10):
-            proc = client.spawn(
+            proc = client.spawn_sync(
                 {"PATH": os.environ.get("PATH", ""),
                  "RT_WORKER_ID": "x"},
                 str(tmp_path / "o"), str(tmp_path / "e"))
@@ -86,8 +86,145 @@ def test_client_stale_socket_and_fallback(tmp_path):
     assert not os.path.exists(sock)
     # after close() the next spawn restarts a template (or cleanly
     # falls back to None) — it must not error against the dead socket
-    proc2 = client.spawn(
+    proc2 = client.spawn_sync(
         {"PATH": os.environ.get("PATH", ""), "RT_WORKER_ID": "y"},
         str(tmp_path / "o2"), str(tmp_path / "e2"))
     assert proc2 is None or isinstance(proc2, ForkedProc)
     client.close()
+
+
+# ---------------------------------------------------------------- async client
+
+def _wedged_template(tmp_path):
+    """A fake template that binds the socket, accepts connections, and
+    never replies — the pathology the deadline-bounded client exists
+    for.  Returns (sock_path, server_socket)."""
+    import socket as _socket
+    sock = str(tmp_path / "wedge.sock")
+    srv = _socket.socket(_socket.AF_UNIX)
+    srv.bind(sock)
+    srv.listen(64)
+    return sock, srv
+
+
+def test_spawn_deadline_retires_generation_and_backs_off(
+        tmp_path, monkeypatch):
+    """A wedged template must cost one spawn deadline, then be killed
+    and the restart gated by backoff — not hammered every spawn."""
+    monkeypatch.setenv("RT_FORKSERVER_SPAWN_TIMEOUT_S", "0.3")
+    monkeypatch.setenv("RT_FORKSERVER_CONNECT_TIMEOUT_S", "0.3")
+    from ray_tpu._private.config import reset_config
+    reset_config()
+    sock, srv = _wedged_template(tmp_path)
+    client = ForkserverClient(sock, str(tmp_path / "fs.log"))
+    # Make the client believe this is ITS live template so the deadline
+    # path (not the boot path) is exercised.
+    fake = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    client.proc = fake
+    client._started_at = time.monotonic()
+    try:
+        gen = client._generation
+        t0 = time.monotonic()
+        proc = client.spawn_sync({"X": "1"}, str(tmp_path / "o"),
+                                 str(tmp_path / "e"))
+        elapsed = time.monotonic() - t0
+        assert proc is None                      # fell back, not hung
+        assert elapsed < 5.0                     # bounded by deadline
+        assert client._generation == gen + 1     # generation retired
+        assert client._failures == 1
+        assert client._next_start > time.monotonic() - 1  # backoff armed
+        # the wedged "template" was killed
+        deadline = time.monotonic() + 5
+        while fake.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fake.poll() is not None
+        # during backoff, spawn returns None instantly without restart
+        t0 = time.monotonic()
+        assert client.spawn_sync({"X": "1"}, str(tmp_path / "o"),
+                                 str(tmp_path / "e")) is None
+        assert time.monotonic() - t0 < 0.5
+        assert client.proc is None               # still backing off
+    finally:
+        if fake.poll() is None:
+            fake.kill()
+        srv.close()
+        client.close()
+        reset_config()
+
+
+def test_concurrent_timeouts_retire_generation_once(tmp_path, monkeypatch):
+    """50 in-flight spawns hitting their deadline together must not each
+    bump the failure counter (backoff would explode to hours)."""
+    monkeypatch.setenv("RT_FORKSERVER_SPAWN_TIMEOUT_S", "0.3")
+    from ray_tpu._private.config import reset_config
+    reset_config()
+    sock, srv = _wedged_template(tmp_path)
+    client = ForkserverClient(sock, str(tmp_path / "fs.log"))
+    fake = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    client.proc = fake
+    client._started_at = time.monotonic()
+
+    import asyncio
+
+    async def storm():
+        return await asyncio.gather(*[
+            client.spawn({"X": "1"}, str(tmp_path / "o"),
+                         str(tmp_path / "e"))
+            for _ in range(50)])
+
+    try:
+        results = asyncio.run(storm())
+        assert all(r is None for r in results)
+        assert client._failures == 1             # retired exactly once
+    finally:
+        if fake.poll() is None:
+            fake.kill()
+        srv.close()
+        client.close()
+        reset_config()
+
+
+def test_spawn_storm_does_not_stall_event_loop(tmp_path, monkeypatch):
+    """50 concurrent spawns against a wedged template must leave the
+    event loop responsive: the watchdog's observed lag stays far below
+    the GCS health timeout (15s) for the whole storm."""
+    monkeypatch.setenv("RT_FORKSERVER_SPAWN_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("RT_FORKSERVER_CONNECT_TIMEOUT_S", "1.0")
+    from ray_tpu._private.config import reset_config
+    reset_config()
+    sock, srv = _wedged_template(tmp_path)
+    client = ForkserverClient(sock, str(tmp_path / "fs.log"))
+    fake = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    client.proc = fake
+    client._started_at = time.monotonic()
+
+    import asyncio
+    from ray_tpu._private.loop_watchdog import LoopWatchdog
+
+    async def storm():
+        wd = LoopWatchdog("test-storm", interval_s=0.05, warn_s=30.0)
+        wd.start()
+        try:
+            await asyncio.gather(*[
+                client.spawn({"X": "1"}, str(tmp_path / "o"),
+                             str(tmp_path / "e"))
+                for _ in range(50)])
+            await asyncio.sleep(0.2)     # let the probe take a sample
+            return wd.max_recent_s(60.0)
+        finally:
+            wd.stop()
+
+    try:
+        max_lag = asyncio.run(storm())
+        # generous bound for a loaded 1-core CI box; the failure mode
+        # being pinned (blocking recv) would park the loop for >1s/spawn
+        assert max_lag < 5.0, f"loop stalled {max_lag:.2f}s during storm"
+    finally:
+        if fake.poll() is None:
+            fake.kill()
+        srv.close()
+        client.close()
+        reset_config()
