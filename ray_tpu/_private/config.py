@@ -29,6 +29,17 @@ class RtConfig:
     inline_max_bytes: int = 100 * 1024      # owner-inline object ceiling
     transfer_chunk_bytes: int = 4 * 1024 * 1024  # node-to-node pull frames
     push_inflight_chunks: int = 4           # per-link push pipelining cap
+    # -- object data-plane integrity (crc32 stamped at seal time, carried
+    #    through the directory, transfer frames, and the spill header;
+    #    0 disables verification, not the stamping plumbing) --
+    transfer_checksum: int = 1
+    spill_fsync: int = 1                    # fsync spill file+dir pre-rename
+    # Pull rounds: each round re-fetches locations from the GCS, so a
+    # stale post-death view or a briefly-unreachable holder costs backoff
+    # latency, not an ObjectLostError/lineage reconstruction.
+    pull_retry_attempts: int = 3
+    pull_retry_backoff_base_s: float = 0.2
+    pull_retry_backoff_max_s: float = 2.0
     # -- control plane --
     heartbeat_period_s: float = 0.5
     health_timeout_s: float = 15.0          # missed-heartbeat death window

@@ -42,6 +42,8 @@ from ray_tpu import exceptions as rex
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, task_id_generator
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_transfer import (ChecksumError, crc32_segments,
+                                              fetch_object_into)
 from ray_tpu._private.plasma import PlasmaClient
 from ray_tpu._private.protocol import ConnectionLost, RpcConnection, RpcServer, connect
 from ray_tpu._private.serialization import get_context
@@ -547,11 +549,16 @@ class CoreWorker:
         else:
             await self._plasma_put(oid, ser)
             self._store_local(h, "plasma", None)
+            # Seal-time integrity stamp: the plasma copy is the segment
+            # concatenation, so crc over segments == crc over the copy.
             await self.gcs.request({"type": "object_location_add",
                                     "object_id": h,
                                     "node_id": self.node_id_hex,
                                     "owner": self.address,
-                                    "size": ser.total_size})
+                                    "size": ser.total_size,
+                                    "checksum": crc32_segments(ser.segments)
+                                    if _rt_config().transfer_checksum
+                                    else None})
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
         return self._run(self.get_objects_async(refs, timeout))
@@ -797,8 +804,9 @@ class CoreWorker:
             logger.debug("client-mode remote fetch of %s: directory lookup "
                          "failed", oid_hex[:16], exc_info=True)
             return None
-        from ray_tpu._private.object_transfer import fetch_object_into
         holders = set(loc.get("nodes", [])) | set(loc.get("spilled", {}))
+        checksum = loc.get("checksum") \
+            if _rt_config().transfer_checksum else None
 
         async def _alloc(total: int):
             return bytearray(total)
@@ -811,9 +819,24 @@ class CoreWorker:
             # raylet's own pull path).
             try:
                 conn = await self._get_worker_conn(n["address"])
-                buf = await fetch_object_into(conn, oid_hex, _alloc)
+                buf = await fetch_object_into(conn, oid_hex, _alloc,
+                                              checksum=checksum)
                 if buf is not None:
                     return bytes(buf)
+            except ChecksumError as e:
+                # Same quarantine contract as the raylet pull path: a
+                # client must not hand corrupted bytes to user code, and
+                # the bad copy must stop being advertised.
+                logger.warning("client-mode fetch of %s from node %s: %s; "
+                               "invalidating that copy", oid_hex[:16],
+                               n["node_id"][:12], e)
+                try:
+                    await self.gcs.request({
+                        "type": "object_location_invalidate",
+                        "object_id": oid_hex, "node_id": n["node_id"],
+                        "reason": str(e)})
+                except Exception:
+                    pass
             except Exception:
                 logger.debug("client-mode fetch of %s from %s failed",
                              oid_hex[:16], n["address"], exc_info=True)
@@ -1903,5 +1926,7 @@ class CoreWorker:
         await self.gcs.request({
             "type": "object_location_add", "object_id": h,
             "node_id": self.node_id_hex, "owner": "",
-            "size": ser.total_size})
+            "size": ser.total_size,
+            "checksum": crc32_segments(ser.segments)
+            if _rt_config().transfer_checksum else None})
         return (h, "plasma", None)

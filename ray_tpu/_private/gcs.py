@@ -130,6 +130,10 @@ class ObjectDirEntry:
     nodes: Set[str] = field(default_factory=set)
     spilled: Dict[str, str] = field(default_factory=dict)  # node hex -> path
     size: int = 0          # bytes (locality-aware lease weighting)
+    # Seal-time crc32 stamped by the creator; pullers/pushers verify a
+    # transferred copy against it before sealing (None for objects that
+    # predate stamping or were created with transfer_checksum=0).
+    checksum: Optional[int] = None
 
 
 @dataclass
@@ -184,6 +188,11 @@ class GcsServer:
         # re-registration drops the entry outright because the live node
         # resumes reporting the same lifetime counters itself.
         self._dead_spill_totals: Dict[str, Dict[str, int]] = {}
+        # Corruption strikes per node (checksum-mismatch invalidations
+        # reported against it) — the data-plane health signal the
+        # dashboard exports per node id.  Survives the node's death (a
+        # node that served garbage and died is still part of the story).
+        self.object_invalidations: Dict[str, int] = {}
         self.server = RpcServer(self._make_handler)
         self._persist_path = persist_path
         self._watchdog = None   # LoopWatchdog, created in start()
@@ -380,9 +389,16 @@ class GcsServer:
         self.node_stats[msg["node_id"]] = msg["stats"]
         return None
 
+    # Lifetime per-raylet counters that must survive node death in the
+    # cluster-wide totals (see _mark_node_dead fold + util.state).
+    _FOLDED_COUNTERS = ("spilled_objects", "restored_objects",
+                        "objects_corrupted", "pull_retries",
+                        "spill_fsync_ms")
+
     def dead_spill_totals(self) -> Dict[str, int]:
-        """Aggregate spill/restore counters folded from dead nodes."""
-        totals = {"spilled_objects": 0, "restored_objects": 0}
+        """Aggregate spill/restore/integrity counters folded from dead
+        nodes."""
+        totals = {k: 0 for k in self._FOLDED_COUNTERS}
         for entry in self._dead_spill_totals.values():
             for k in totals:
                 totals[k] += entry.get(k, 0)
@@ -393,9 +409,12 @@ class GcsServer:
         # lifetime spill/restore counters of dead nodes as an explicit
         # field (it used to ride inside the map under a synthetic
         # "__dead_nodes__" key, which every consumer had to know to
-        # skip).
+        # skip).  "invalidations" is the per-node corruption-strike map
+        # (kept GCS-side: strikes are reported BY detectors AGAINST
+        # holders, so no single raylet can report them).
         return {"nodes": self.node_stats,
-                "dead_totals": self.dead_spill_totals()}
+                "dead_totals": self.dead_spill_totals(),
+                "invalidations": dict(self.object_invalidations)}
 
     async def _h_profile_worker(self, conn, msg):
         """Route a stack-profile request to the raylet hosting ``pid``
@@ -605,9 +624,7 @@ class GcsServer:
             # Overwrite (not +=): the counters are lifetime totals, so a
             # node that died before with the same id replaces its entry.
             self._dead_spill_totals[node.node_id.hex()] = {
-                "spilled_objects": dropped.get("spilled_objects", 0),
-                "restored_objects": dropped.get("restored_objects", 0),
-            }
+                k: dropped.get(k, 0) for k in self._FOLDED_COUNTERS}
         await self._publish("nodes", {"event": "dead", "node": node.public()})
         # Restart or kill actors that lived on this node.
         for actor in list(self.actors.values()):
@@ -1065,12 +1082,18 @@ class GcsServer:
         entry = self.object_dir.get(oid)
         if entry is None:
             self.object_dir[oid] = ObjectDirEntry(
-                owner, {msg["node_id"]}, size=int(msg.get("size", 0)))
+                owner, {msg["node_id"]}, size=int(msg.get("size", 0)),
+                checksum=msg.get("checksum"))
         else:
             entry.nodes.add(msg["node_id"])
             entry.spilled.pop(msg["node_id"], None)  # restored
             if msg.get("size"):
                 entry.size = int(msg["size"])
+            if msg.get("checksum") is not None:
+                # The creator's stamp is authoritative; later adds are
+                # pullers registering a verified copy (same bytes), and a
+                # reconstruction re-stamps through the same path.
+                entry.checksum = msg["checksum"]
         return {"ok": True}
 
     async def _h_object_locations_get_many(self, conn, msg):
@@ -1082,7 +1105,8 @@ class GcsServer:
             if entry is not None:
                 out[oid] = {"nodes": list(entry.nodes),
                             "spilled": dict(entry.spilled),
-                            "size": entry.size}
+                            "size": entry.size,
+                            "checksum": entry.checksum}
         return out
 
     async def _h_object_locations_get(self, conn, msg):
@@ -1090,7 +1114,8 @@ class GcsServer:
         if entry is None:
             return None
         return {"owner": entry.owner, "nodes": list(entry.nodes),
-                "spilled": dict(entry.spilled)}
+                "spilled": dict(entry.spilled),
+                "checksum": entry.checksum}
 
     async def _h_object_location_remove(self, conn, msg):
         entry = self.object_dir.get(msg["object_id"])
@@ -1099,6 +1124,35 @@ class GcsServer:
             if not entry.nodes and not entry.spilled:
                 del self.object_dir[msg["object_id"]]
         return {"ok": True}
+
+    async def _h_object_location_invalidate(self, conn, msg):
+        """A puller/restorer detected checksum-mismatched bytes served by
+        ``node_id``: quarantine that copy — drop it from the directory so
+        no other puller is routed to it — and count the strike against the
+        node (`/api/metrics` ray_tpu_object_location_invalidations).  The
+        copy itself is left to its holder; with the location gone it is
+        unreachable, and deleting it remotely would destroy a possibly
+        healthy copy when the corruption happened in transit."""
+        oid = msg["object_id"]
+        nh = msg["node_id"]
+        self.object_invalidations[nh] = \
+            self.object_invalidations.get(nh, 0) + 1
+        entry = self.object_dir.get(oid)
+        removed = False
+        if entry is not None:
+            if nh in entry.nodes:
+                entry.nodes.discard(nh)
+                removed = True
+            if entry.spilled.pop(nh, None) is not None:
+                removed = True
+            if not entry.nodes and not entry.spilled:
+                del self.object_dir[oid]
+        logger.warning(
+            "object %s copy on node %s invalidated (%s); %d strikes "
+            "against that node", oid[:16], nh[:12],
+            msg.get("reason", "checksum mismatch"),
+            self.object_invalidations[nh])
+        return {"ok": True, "removed": removed}
 
     async def _h_object_spilled(self, conn, msg):
         """A node moved its in-memory copy to disk (reference:

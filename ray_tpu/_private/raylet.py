@@ -36,8 +36,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private import object_transfer
+from ray_tpu._private.object_transfer import ChecksumError
 from ray_tpu._private import plasma as plasma_mod
-from ray_tpu._private.plasma import PlasmaClient
+from ray_tpu._private.plasma import ObjectStoreFullError, PlasmaClient
 from ray_tpu._private.protocol import RpcConnection, RpcServer, connect
 
 logger = logging.getLogger(__name__)
@@ -152,6 +154,15 @@ class Raylet:
             tempfile.gettempdir(),
             f"rt_spill_{os.getpid()}_{node_id.hex()[:12]}")
         os.makedirs(self.spill_dir, exist_ok=True)
+        # Orphaned .tmp files are spill writes that died before their
+        # rename; they were never registered anywhere, so they are pure
+        # disk leakage — sweep them at start.
+        import glob as _glob
+        for stale in _glob.glob(os.path.join(self.spill_dir, "*.tmp")):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
         # Worker log capture (reference _private/log_monitor.py): every
         # worker's stdout/stderr goes to per-process files in log_dir and a
         # poll task tails them to the GCS "worker_logs" pubsub channel.
@@ -163,6 +174,12 @@ class Raylet:
         # spill/restore counters (node stats -> Dataset.stats footer)
         self._spilled_objects = 0
         self._restored_objects = 0
+        # Data-plane health counters (node stats + /api/metrics):
+        # checksum mismatches THIS node detected, extra pull rounds it
+        # needed, and cumulative ms its spills spent in fsync.
+        self._objects_corrupted = 0
+        self._pull_retries = 0
+        self._spill_fsync_ms = 0.0
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
         # CPU-worker forkserver (lazy; see _private/forkserver.py): one
@@ -346,6 +363,9 @@ class Raylet:
             "workers": workers,
             "spilled_objects": self._spilled_objects,
             "restored_objects": self._restored_objects,
+            "objects_corrupted": self._objects_corrupted,
+            "pull_retries": self._pull_retries,
+            "spill_fsync_ms": round(self._spill_fsync_ms, 3),
         }
         if self._watchdog is not None:
             out.update(self._watchdog.record())
@@ -1079,18 +1099,24 @@ class Raylet:
                     view.release()
                     self.plasma.release(oid)
                 path = self._spill_path(oid_hex)
+                do_fsync = bool(config().spill_fsync)
 
-                def _write(p=path, d=data):
-                    tmp = p + ".tmp"
-                    with open(tmp, "wb") as f:
-                        f.write(d)
-                    os.replace(tmp, p)
+                def _write(p=path, d=data, fs=do_fsync):
+                    return object_transfer.write_spill_file(p, d,
+                                                            do_fsync=fs)
 
                 # Disk IO off the event loop: a multi-MB write must not
                 # stall heartbeats/leases (reference spills on an io worker
-                # pool for the same reason).
-                await asyncio.get_running_loop().run_in_executor(None,
-                                                                 _write)
+                # pool for the same reason).  The write is header+fsync
+                # durable: post-crash the file is either absent or
+                # complete and crc-verifiable, never torn.
+                _, fsync_s = await asyncio.get_running_loop() \
+                    .run_in_executor(None, _write)
+                self._spill_fsync_ms += fsync_s * 1000.0
+                from ray_tpu.util import fault_injection
+                if fault_injection.truncate_spill(path):
+                    logger.warning("fault injection: truncated spill file "
+                                   "for %s", oid_hex[:16])
                 if not self.plasma.delete(oid):
                     if self.plasma.contains(oid):
                         os.unlink(path)  # pinned by a reader; stays in memory
@@ -1127,7 +1153,6 @@ class Raylet:
         spilling; LRU eviction is the very last resort (it can only be
         reached when nothing is left to spill, so anything it takes is a
         secondary copy or untracked)."""
-        from ray_tpu._private.plasma import ObjectStoreFullError
         try:
             return self.plasma.create(oid, size, allow_evict=False)
         except ObjectStoreFullError:
@@ -1137,17 +1162,48 @@ class Raylet:
             except ObjectStoreFullError:
                 return self.plasma.create(oid, size)
 
+    async def _invalidate_location(self, oid_hex: str, node_hex: str,
+                                   reason: str = "checksum mismatch"):
+        """Report a corrupt copy to the GCS so no other puller is routed
+        to it (best-effort: a miss costs a wasted pull elsewhere, not
+        correctness — the detecting side never seals bad bytes)."""
+        try:
+            await self.gcs_conn.request({
+                "type": "object_location_invalidate", "object_id": oid_hex,
+                "node_id": node_hex, "reason": reason})
+        except Exception:
+            logger.debug("location invalidate for %s failed", oid_hex[:16],
+                         exc_info=True)
+
     async def _restore_spilled(self, oid: ObjectID) -> bool:
-        """Disk -> plasma (reference: LocalObjectManager restore path)."""
+        """Disk -> plasma (reference: LocalObjectManager restore path).
+
+        The spill header is verified BEFORE seal: a torn or bit-rotted
+        file is deleted and its location invalidated so consumers fall
+        through to another copy (or lineage), instead of the old behavior
+        of sealing the garbage and re-advertising it cluster-wide."""
         path = self._spill_path(oid.hex())
         if not os.path.exists(path):
             return False
+        verify = bool(config().transfer_checksum)
 
         def _read():
-            with open(path, "rb") as f:
-                return f.read()
+            return object_transfer.read_spill_file(path, verify=verify)
 
-        data = await asyncio.get_running_loop().run_in_executor(None, _read)
+        try:
+            data, _ = await asyncio.get_running_loop().run_in_executor(
+                None, _read)
+        except (ChecksumError, OSError) as e:
+            logger.warning("spill file for %s unusable (%s); quarantining",
+                           oid.hex()[:16], e)
+            self._objects_corrupted += 1
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            await self._invalidate_location(oid.hex(), self.node_id.hex(),
+                                           reason=str(e))
+            return False
         if not self.plasma.contains(oid):
             buf = await self._create_with_spill(oid, len(data))
             buf[:] = data
@@ -1221,55 +1277,107 @@ class Raylet:
     async def _h_fetch_object(self, conn, msg):
         """Serve an object from local plasma as chunked frames (push side).
         Falls back to this node's spill file so a spilled copy stays
-        fetchable without forcing a restore into a full store."""
+        fetchable without forcing a restore into a full store.  Spill-file
+        frames carry the header's crc32 so even a GCS-checksum-less object
+        is verifiable end-to-end."""
+        from ray_tpu.util import fault_injection
+        if fault_injection.drop_fetch_reply():
+            # Error reply, not silence: the puller should see a prompt
+            # per-candidate failure, not park on its RPC timeout.
+            raise RuntimeError("fault injection: fetch reply dropped")
         oid = ObjectID.from_hex(msg["object_id"])
+        offset = msg.get("offset", 0)
         view = self.plasma.get(oid)
         if view is None:
             path = self._spill_path(msg["object_id"])
             try:
-                total = os.path.getsize(path)
-                offset = msg.get("offset", 0)
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    data = f.read(TRANSFER_CHUNK())
-                return {"found": True, "total": total, "offset": offset,
-                        "data": data}
+                # Spill reads go through the executor: a disk read on the
+                # raylet loop is exactly the stall class the loop watchdog
+                # exists to flag.
+                total, crc, data = await asyncio.get_running_loop() \
+                    .run_in_executor(None, object_transfer.read_spill_chunk,
+                                     path, offset, TRANSFER_CHUNK())
             except OSError:
                 return {"found": False}
+            reply = {"found": True, "total": total, "offset": offset,
+                     "data": fault_injection.corrupt_chunk(data)}
+            if crc is not None:
+                reply["checksum"] = crc
+            return reply
         try:
             total = len(view)
-            offset = msg.get("offset", 0)
             end = min(offset + TRANSFER_CHUNK(), total)
-            return {"found": True, "total": total, "offset": offset,
-                    "data": bytes(view[offset:end])}
+            data = bytes(view[offset:end])
         finally:
             view.release()
             self.plasma.release(oid)
+        return {"found": True, "total": total, "offset": offset,
+                "data": fault_injection.corrupt_chunk(data)}
 
     async def _h_pull_object(self, conn, msg):
-        """Pull an object from a remote node into local plasma."""
-        oid = ObjectID.from_hex(msg["object_id"])
-        if self.plasma.contains(oid):
-            return {"ok": True}
+        """Pull an object into local plasma, with bounded location-refresh
+        retry rounds (reference pull_manager's periodic re-pull).  A stale
+        post-death cluster view or a briefly-unreachable holder costs
+        backoff latency here; only exhausted retries surface as a failed
+        pull, which is when the owner's ObjectLostError/lineage machinery
+        is allowed to kick in."""
+        oid_hex = msg["object_id"]
+        oid = ObjectID.from_hex(oid_hex)
+        cfg = config()
+        attempts = max(1, int(cfg.pull_retry_attempts))
+        last_err = "no locations"
+        for attempt in range(attempts):
+            if attempt:
+                self._pull_retries += 1
+                await asyncio.sleep(min(
+                    cfg.pull_retry_backoff_max_s,
+                    cfg.pull_retry_backoff_base_s * (2 ** (attempt - 1))))
+            if self.plasma.contains(oid):
+                return {"ok": True}
+            try:
+                sealed, last_err = await self._pull_round(oid_hex, oid)
+            except ObjectStoreFullError as e:
+                # A full store mid-restore/seal is an answer, not a crash:
+                # reply {"ok": False} so the owner can decide, instead of
+                # leaking an unhandled exception out of the RPC handler.
+                return {"ok": False, "error": f"object store full: {e}"}
+            if sealed:
+                await self._register_pulled(oid_hex)
+                return {"ok": True}
+        return {"ok": False, "error": last_err}
+
+    async def _pull_round(self, oid_hex: str, oid: ObjectID
+                          ) -> Tuple[bool, str]:
+        """One pull round: refresh locations from the GCS, then try every
+        live holder.  Returns (sealed, last error).  Checksum-mismatched
+        copies are quarantined (local delete + directory invalidation) and
+        the sweep falls through to the next copy — garbage is never
+        sealed.  ObjectStoreFullError propagates to the caller."""
         loc = await self.gcs_conn.request({"type": "object_locations_get",
-                                           "object_id": msg["object_id"]})
+                                           "object_id": oid_hex})
         spilled = (loc or {}).get("spilled", {})
         if loc is None or (not loc["nodes"] and not spilled):
-            return {"ok": False, "error": "no locations"}
+            return False, "no locations"
+        checksum = loc.get("checksum") if config().transfer_checksum \
+            else None
+        me = self.node_id.hex()
         # Spilled on this very node: restore from the local disk file.
-        if self.node_id.hex() in spilled:
-            if await self._restore_spilled(oid):
-                return {"ok": True}
+        if me in spilled and await self._restore_spilled(oid):
+            return True, ""
         nodes = await self.gcs_conn.request({"type": "get_nodes"})
-        holders = set(loc["nodes"]) | set(spilled)
-        candidates = [n["address"] for n in nodes
-                      if n["node_id"] in holders and n["alive"] and
-                      n["node_id"] != self.node_id.hex()]
+        addr_by_id = {n["node_id"]: n["address"] for n in nodes
+                      if n["alive"]}
+        # In-memory holders before spilled ones: a plasma read beats a
+        # peer's disk read — and the ordering is what lets a corrupt
+        # memory copy be detected and quarantined before the (healthy)
+        # spill copy is even touched.
+        candidates = []
+        for nh in list(loc["nodes"]) + list(spilled):
+            if nh != me and nh in addr_by_id and \
+                    nh not in (c[0] for c in candidates):
+                candidates.append((nh, addr_by_id[nh]))
         if not candidates:
-            return {"ok": False, "error": "no live remote location"}
-        # A location can be stale (node just died, GCS hasn't noticed):
-        # treat per-node connect/fetch failures as "try the next copy".
-        from ray_tpu._private.object_transfer import fetch_object_into
+            return False, "no live remote location"
         allocated = []
 
         async def _alloc(total: int):
@@ -1277,35 +1385,63 @@ class Raylet:
             allocated.append(b)
             return b
 
-        done = False
-        for addr in candidates:
+        last_err = "object missing at all locations"
+        for nh, addr in candidates:
             if self.plasma.contains(oid):
-                return {"ok": True}
+                return True, ""
             try:
                 peer = await self._peer(addr)
-                buf = await fetch_object_into(
-                    peer, msg["object_id"], _alloc)
+                buf = await object_transfer.fetch_object_into(
+                    peer, oid_hex, _alloc, checksum=checksum)
+            except ObjectStoreFullError:
+                raise
+            except ChecksumError as e:
+                logger.warning("pull %s from node %s: %s; invalidating "
+                               "that copy", oid_hex[:16], nh[:12], e)
+                self._objects_corrupted += 1
+                last_err = str(e)
+                await self._invalidate_location(oid_hex, nh)
+                buf = None
             except Exception as e:
+                # A location can be stale (node just died, GCS hasn't
+                # noticed): a per-node connect/fetch failure means "try
+                # the next copy", and the next round re-asks the GCS.
                 logger.debug("pull %s from %s failed: %s",
-                             msg["object_id"][:16], addr, e)
+                             oid_hex[:16], addr, e)
+                last_err = f"fetch from node {nh[:12]} failed: {e}"
                 buf = None
             if buf is not None:
-                done = True
-                break
+                self.plasma.seal(oid)
+                self.plasma.release(oid)
+                return True, ""
             if allocated:
-                # Truncated/evicted mid-transfer: free the half-written
-                # allocation and try the next holder.
+                # Truncated/evicted/corrupted mid-transfer: free the
+                # half-written allocation and try the next holder.
                 self.plasma.release(oid)
                 self.plasma.delete(oid)
                 allocated.clear()
-        if not done:
-            return {"ok": False, "error": "object missing at all locations"}
-        self.plasma.seal(oid)
-        self.plasma.release(oid)
-        await self.gcs_conn.request({"type": "object_location_add",
-                                     "object_id": msg["object_id"],
-                                     "node_id": self.node_id.hex()})
-        return {"ok": True}
+        return False, last_err
+
+    async def _register_pulled(self, oid_hex: str):
+        """Advertise the freshly pulled copy.  A held-but-unadvertised
+        copy is invisible to every other puller and to the spill
+        machinery, so a failed add is retried once before giving up with
+        a loud log (the object itself is safe either way)."""
+        for attempt in (0, 1):
+            try:
+                await self.gcs_conn.request({"type": "object_location_add",
+                                             "object_id": oid_hex,
+                                             "node_id": self.node_id.hex()})
+                return
+            except Exception:
+                if attempt:
+                    logger.warning(
+                        "object_location_add for %s failed twice; local "
+                        "copy is held but unadvertised", oid_hex[:16],
+                        exc_info=True)
+                else:
+                    logger.info("object_location_add for %s failed; "
+                                "retrying once", oid_hex[:16])
 
     # -- push-based transfer (reference object_manager/push_manager.h:29) --
 
@@ -1319,16 +1455,28 @@ class Raylet:
 
     async def _push_to(self, target_addr: str, oid_hex: str,
                        timeout: float = 120) -> bool:
-        from ray_tpu._private.object_transfer import push_object_chunks
         oid = ObjectID.from_hex(oid_hex)
         view = self.plasma.get(oid)
         if view is None:
             return False
         try:
+            checksum = None
+            if config().transfer_checksum:
+                # The directory's seal-time stamp rides in the frames so
+                # the receiver verifies against the CREATOR's bytes, not
+                # whatever this (possibly corrupt) holder serves.
+                try:
+                    loc = await self.gcs_conn.request(
+                        {"type": "object_locations_get",
+                         "object_id": oid_hex})
+                    checksum = (loc or {}).get("checksum")
+                except Exception:
+                    checksum = None
             peer = await self._peer(target_addr)
-            return await push_object_chunks(
+            return await object_transfer.push_object_chunks(
                 peer, oid_hex, view, len(view), TRANSFER_CHUNK(),
-                config().push_inflight_chunks, timeout=timeout)
+                config().push_inflight_chunks, timeout=timeout,
+                checksum=checksum, src_node=self.node_id.hex())
         finally:
             view.release()
             self.plasma.release(oid)
@@ -1349,7 +1497,8 @@ class Raylet:
             # of the same push must wait on `ready`, not double-create.
             st = {"buf": None, "total": msg["total"], "offsets": set(),
                   "received": 0, "t": now, "ready": asyncio.Event(),
-                  "error": None}
+                  "error": None, "checksum": msg.get("checksum"),
+                  "src_node": msg.get("src_node")}
             self._incoming[oid_hex] = st
             try:
                 st["buf"] = await self._create_with_spill(oid, msg["total"])
@@ -1372,6 +1521,23 @@ class Raylet:
             st["received"] += len(data)
         if st["received"] >= st["total"]:
             self._incoming.pop(oid_hex, None)
+            expect = st.get("checksum")
+            if expect is not None and config().transfer_checksum and \
+                    object_transfer.crc32_bytes(st["buf"]) != expect:
+                # Never seal garbage: free the assembly, count the strike,
+                # and quarantine the pusher's copy (the pusher sees ok
+                # False and its push fails loudly).
+                self.plasma.release(oid)
+                self.plasma.delete(oid)
+                self._objects_corrupted += 1
+                src = st.get("src_node")
+                logger.warning("pushed object %s from node %s failed crc32 "
+                               "verification; rejected", oid_hex[:16],
+                               (src or "?")[:12])
+                if src:
+                    await self._invalidate_location(oid_hex, src)
+                return {"ok": False, "done": False,
+                        "error": "checksum mismatch"}
             self.plasma.seal(oid)
             self.plasma.release(oid)
             await self.gcs_conn.request({"type": "object_location_add",

@@ -208,6 +208,24 @@ class DashboardHttpServer:
                     "labels": {"component": "raylet",
                                "node_id": node_id},
                     "value": st["loop_lag_ms"]})
+        # Data-plane health (alongside loop_lag_ms): per-node corruption
+        # detections, pull retry rounds, and spill fsync time from node
+        # stats, plus the GCS-side corruption strikes AGAINST each node
+        # (these outlive the node — a holder that served garbage and died
+        # is still part of the story).
+        for node_id, st in self.gcs.node_stats.items():
+            for name in ("objects_corrupted", "pull_retries",
+                         "spill_fsync_ms"):
+                if name in st:
+                    lag_records.append({
+                        "name": name, "type": "counter",
+                        "labels": {"node_id": node_id},
+                        "value": st[name]})
+        for node_id, strikes in getattr(
+                self.gcs, "object_invalidations", {}).items():
+            lag_records.append({
+                "name": "object_location_invalidations", "type": "counter",
+                "labels": {"node_id": node_id}, "value": strikes})
         # User metrics: reuse the GCS's (name, labels) aggregation and the
         # shared exposition renderer (which sanitizes names) — per-process
         # raw records would emit duplicate series and drop histogram

@@ -44,6 +44,18 @@ class FaultSpec:
     forkserver: Optional[Any] = None     # "wedge" | {"mode","delay_s"}
     heartbeat_delay_s: float = 0.0
     drop_rpc: Optional[Dict[str, Any]] = None
+    # Data-plane faults (see raylet fetch/spill paths):
+    # corrupt_chunk: {"every": N} — bit-flip every Nth fetch chunk SERVED
+    # by this process (models bad RAM/NIC on a holder node).
+    corrupt_chunk: Optional[Any] = None
+    # truncate_spill: {"every": N, "keep": fraction} — truncate every Nth
+    # spill file right after its durable write (models a torn write that
+    # survived a crash, the exact artifact the spill header detects).
+    truncate_spill: Optional[Any] = None
+    # drop_fetch_reply: {"every": N} — fail every Nth fetch_object request
+    # with an error reply (models a flaky holder; the puller's retry
+    # rounds, not lineage, should absorb it).
+    drop_fetch_reply: Optional[Any] = None
 
     @classmethod
     def from_env(cls) -> "FaultSpec":
@@ -58,10 +70,33 @@ class FaultSpec:
             forkserver=raw.get("forkserver"),
             heartbeat_delay_s=float(raw.get("heartbeat_delay_s", 0.0)),
             drop_rpc=raw.get("drop_rpc"),
+            corrupt_chunk=raw.get("corrupt_chunk"),
+            truncate_spill=raw.get("truncate_spill"),
+            drop_fetch_reply=raw.get("drop_fetch_reply"),
         )
 
 
 _spec_cache: Optional[FaultSpec] = None
+
+# Per-process every-Nth counters for the data-plane faults (deterministic,
+# like make_drop_filter's per-connection counts).
+_counters: Dict[str, int] = {}
+
+
+def _every_nth(name: str, fault: Any) -> bool:
+    """True on the Nth, 2Nth, ... consultation of ``name`` while ``fault``
+    is active.  Accepts {"every": N}, a bare int N, or true (N=1)."""
+    if not fault:
+        return False
+    if isinstance(fault, dict):
+        every = int(fault.get("every", 1))
+    elif isinstance(fault, bool):
+        every = 1
+    else:
+        every = int(fault)
+    n = _counters.get(name, 0) + 1
+    _counters[name] = n
+    return every > 0 and n % every == 0
 
 
 def spec() -> FaultSpec:
@@ -77,12 +112,14 @@ def set_spec(**kwargs) -> FaultSpec:
     subprocesses are unaffected).  Pair with clear_spec()."""
     global _spec_cache
     _spec_cache = FaultSpec(**kwargs)
+    _counters.clear()
     return _spec_cache
 
 
 def clear_spec() -> None:
     global _spec_cache
     _spec_cache = None
+    _counters.clear()
 
 
 def env_for(**kwargs) -> Dict[str, str]:
@@ -120,6 +157,43 @@ def make_drop_filter(conn_substr: str, every: int):
         return every > 0 and n % every == 0
 
     return _filter
+
+
+def corrupt_chunk(data: bytes) -> bytes:
+    """Chaos hook for the raylet's fetch-serving path: bit-flip the first
+    byte of every Nth chunk this process serves.  A single flipped bit is
+    the minimal corruption — anything the checksum machinery misses here
+    it would miss in the wild."""
+    if not data or not _every_nth("corrupt_chunk", spec().corrupt_chunk):
+        return data
+    flipped = bytearray(data)
+    flipped[0] ^= 0x01
+    return bytes(flipped)
+
+
+def drop_fetch_reply() -> bool:
+    """Chaos hook at fetch_object entry: True when this request should
+    fail.  The raylet raises (error reply) rather than staying silent so
+    the puller sees a prompt per-candidate failure instead of parking on
+    its RPC timeout."""
+    return _every_nth("drop_fetch_reply", spec().drop_fetch_reply)
+
+
+def truncate_spill(path: str) -> bool:
+    """Chaos hook after a durable spill write: truncate every Nth spill
+    file to ``keep`` (default half) of its on-disk size, simulating the
+    torn write the header+fsync protocol exists to catch.  Returns True
+    when the file was truncated."""
+    fault = spec().truncate_spill
+    if not _every_nth("truncate_spill", fault):
+        return False
+    keep = float(fault.get("keep", 0.5)) if isinstance(fault, dict) else 0.5
+    try:
+        size = os.path.getsize(path)
+        os.truncate(path, max(0, int(size * keep)))
+        return True
+    except OSError:
+        return False
 
 
 # --------------------------------------------------------------- observers

@@ -44,9 +44,11 @@ def list_tasks(limit: int = 20000, *, offset: int = 0,
 
 def node_stats() -> Dict[str, Dict[str, Any]]:
     """Latest per-node agent report (workers, load, memory, object store,
-    ``loop_lag_ms``) keyed by node id.  Dead nodes' lifetime spill
-    counters arrive separately in the RPC's ``dead_totals`` field — use
-    spill_totals() for the cluster-wide lifetime sum."""
+    ``loop_lag_ms``, and the data-plane health counters
+    ``objects_corrupted`` / ``pull_retries`` / ``spill_fsync_ms``) keyed
+    by node id.  Dead nodes' lifetime spill counters arrive separately in
+    the RPC's ``dead_totals`` field — use spill_totals() /
+    data_plane_totals() for the cluster-wide lifetime sums."""
     reply = _gcs_request({"type": "get_node_stats"}) or {}
     return reply.get("nodes", {})
 
@@ -73,6 +75,23 @@ def spill_totals() -> Dict[str, int]:
             sum(s.get("spilled_objects", 0) for s in stats.values()),
             "restored_objects": dead.get("restored_objects", 0) +
             sum(s.get("restored_objects", 0) for s in stats.values())}
+
+
+def data_plane_totals() -> Dict[str, Any]:
+    """Cluster-wide lifetime object data-plane health counters: checksum
+    mismatches detected (``objects_corrupted``), extra pull rounds
+    (``pull_retries``), cumulative spill fsync time (``spill_fsync_ms``)
+    — summed over live nodes plus the dead-node carry-over — and the
+    GCS's per-node corruption-strike map (``invalidations_by_node``:
+    checksum-mismatch invalidations reported AGAINST each node)."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    stats = reply.get("nodes", {})
+    dead = reply.get("dead_totals", {})
+    out: Dict[str, Any] = {}
+    for k in ("objects_corrupted", "pull_retries", "spill_fsync_ms"):
+        out[k] = dead.get(k, 0) + sum(s.get(k, 0) for s in stats.values())
+    out["invalidations_by_node"] = reply.get("invalidations", {})
+    return out
 
 
 def list_objects() -> List[Dict[str, Any]]:

@@ -5,15 +5,31 @@
 # recovery gates actually gate (wall-clock-sleep "synchronization" is
 # exactly what load exposes).
 #
-# Usage: scripts/run_chaos.sh [extra pytest args...]
+# Usage: scripts/run_chaos.sh [profile] [extra pytest args...]
+#   profile: all        - whole -m chaos suite (default)
+#            data-chaos - object data-plane faults only (chunk
+#                         corruption, torn spill files, dropped fetch
+#                         replies; -m "chaos and data_chaos")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
+PROFILE="all"
+case "${1:-}" in
+    all|data-chaos)
+        PROFILE="$1"
+        shift
+        ;;
+esac
+MARKER="chaos"
+if [ "$PROFILE" = "data-chaos" ]; then
+    MARKER="chaos and data_chaos"
+fi
+
 RUNS="${CHAOS_RUNS:-3}"
 BURNERS="${CHAOS_BURNERS:-$((2 * $(nproc)))}"
 
-echo "chaos gate: ${RUNS} runs, ${BURNERS} nice'd CPU burners"
+echo "chaos gate [${PROFILE}]: ${RUNS} runs, ${BURNERS} nice'd CPU burners"
 
 burner_pids=()
 for _ in $(seq "$BURNERS"); do
@@ -30,7 +46,7 @@ fail=0
 for i in $(seq "$RUNS"); do
     echo "=== chaos run ${i}/${RUNS} ==="
     if ! JAX_PLATFORMS=cpu timeout -k 10 900 \
-        python -m pytest tests/ -q -m chaos \
+        python -m pytest tests/ -q -m "$MARKER" \
         -p no:cacheprovider -p no:randomly "$@"; then
         echo "=== chaos run ${i}/${RUNS}: FAILED ==="
         fail=1
