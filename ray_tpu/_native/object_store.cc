@@ -614,6 +614,25 @@ void store_test_die_holding_lock(void* hv) {
   _exit(0);  // dies with the robust mutex held
 }
 
+// Copy the ids of all sealed objects into ``out`` (kIdLen bytes each).
+// Returns the count written; a return value equal to ``max_ids`` may mean
+// truncation — callers retry with a larger buffer.  Used by the raylet's
+// GCS resync to re-advertise local copies after a control-plane partition.
+uint64_t store_list_sealed(void* hv, uint8_t* out, uint64_t max_ids) {
+  Handle* h = static_cast<Handle*>(hv);
+  lock(h);
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->hdr->num_slots && n < max_ids; ++i) {
+    Entry* e = &h->table[i];
+    if (e->state == 2) {
+      std::memcpy(out + n * kIdLen, e->id, kIdLen);
+      ++n;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
 uint64_t store_capacity(void* hv) { return static_cast<Handle*>(hv)->hdr->capacity; }
 uint64_t store_bytes_used(void* hv) { return static_cast<Handle*>(hv)->hdr->bytes_used; }
 uint64_t store_num_objects(void* hv) { return static_cast<Handle*>(hv)->hdr->num_objects; }

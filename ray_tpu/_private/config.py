@@ -52,6 +52,15 @@ class RtConfig:
     # size that logs a warning with the offending-callback hint.
     loop_watchdog_interval_s: float = 0.25
     loop_watchdog_warn_s: float = 1.0
+    # Control-plane partitions: a raylet/driver whose GCS conn drops keeps
+    # redialing (exponential backoff + jitter, each dial deadline-bounded)
+    # while the GCS holds the node DISCONNECTED for a resurrection grace
+    # window — re-registration inside it costs zero actor restarts; only
+    # grace expiry falls through to the normal death path.
+    node_reconnect_grace_s: float = 30.0
+    gcs_reconnect_backoff_base_s: float = 0.2
+    gcs_reconnect_backoff_max_s: float = 5.0
+    gcs_dial_timeout_s: float = 5.0
     gcs_snapshot_period_s: float = 1.0
     node_view_cache_s: float = 0.5          # spill/SPREAD scoring staleness
     task_event_retention: int = 20000

@@ -182,6 +182,11 @@ class CoreWorker:
 
         # actor submission state: actor_id hex -> dict
         self.actor_state: Dict[str, dict] = {}
+        # Lazily armed on the first actor dial: an "actors"-channel
+        # subscription that fences cached connections to restarted
+        # incarnations (split-brain: the old worker may still be alive
+        # behind a partition, so conn.closed alone can't detect it).
+        self._actor_events_subscribed = False
         self._function_cache: Dict[str, Any] = {}
         self._exported_functions: set = set()
 
@@ -219,7 +224,17 @@ class CoreWorker:
         self.server = RpcServer(self._make_handler)
         await self.server.start(0)
         self.address = self.server.address
-        self.gcs = await connect(self.gcs_address, self._handle_push, name="cw->gcs")
+        cfg = _rt_config()
+        # Reconnecting: a driver/worker must survive a GCS blip or head
+        # restart.  Channel subscriptions are per-conn state on the GCS
+        # side, so the reconnect callback replays them.
+        self.gcs = await connect(
+            self.gcs_address, self._handle_push, name="cw->gcs",
+            reconnect=True,
+            dial_timeout_s=cfg.gcs_dial_timeout_s,
+            backoff_base_s=cfg.gcs_reconnect_backoff_base_s,
+            backoff_max_s=cfg.gcs_reconnect_backoff_max_s,
+            on_reconnect=self._on_gcs_reconnect)
         self.raylet = None
         if self.raylet_address:
             self.raylet = await connect(self.raylet_address, self._handle_push,
@@ -266,6 +281,22 @@ class CoreWorker:
         if self.plasma:
             self.plasma.close()
             self.plasma = None
+
+    async def _on_gcs_reconnect(self, conn) -> None:
+        """The GCS link healed (blip or head restart): re-issue every
+        channel subscription.  The GCS keeps subscriber lists per
+        connection, so without this replay all pubsub (actor events, node
+        events, worker logs) would silently stop after any drop."""
+        channels = list(self._subscriptions)
+        for channel in channels:
+            try:
+                await conn.request({"type": "subscribe", "channel": channel})
+            except Exception:
+                logger.warning("re-subscribe to %r after GCS reconnect "
+                               "failed", channel, exc_info=True)
+        if channels:
+            logger.info("re-subscribed %d pubsub channels after GCS "
+                        "reconnect", len(channels))
 
     async def _handle_push(self, msg: dict):
         if msg.get("type") == "pub":
@@ -1838,6 +1869,40 @@ class CoreWorker:
             for oid in return_ids:
                 self._store_local(oid.hex(), "err", payload)
 
+    def _on_actor_event(self, data: dict) -> None:
+        """Pubsub callback (executor pool): fence stale actor connections.
+
+        A restarted actor gets a NEW address while the cached connection
+        to its previous incarnation may still be open — a partitioned
+        node keeps its worker processes alive, so ``conn.closed`` alone
+        cannot detect the zombie.  Any restart/death event, or an alive
+        event whose address differs from the cached one, drops the
+        cached conn; the next call re-resolves through the GCS record."""
+        actor = (data or {}).get("actor") or {}
+        aid = actor.get("actor_id")
+        st = self.actor_state.get(aid)
+        if st is None:
+            return
+        event = (data or {}).get("event")
+        stale = (event in ("restarting", "dead")
+                 or (event == "alive" and st["address"] is not None
+                     and actor.get("address") != st["address"]))
+        if stale:
+            asyncio.run_coroutine_threadsafe(
+                self._invalidate_actor_conn(aid, event), self.loop)
+
+    async def _invalidate_actor_conn(self, actor_id_hex: str, why: str):
+        st = self.actor_state.get(actor_id_hex)
+        if st is None:
+            return
+        conn, st["conn"], st["address"] = st["conn"], None, None
+        if conn is not None and not conn.closed:
+            logger.info("actor %s %s: dropping cached connection",
+                        actor_id_hex[:12], why)
+            # Closing fails this conn's in-flight calls with
+            # ConnectionLost; they re-resolve via the fallback path.
+            await conn.close()
+
     async def _actor_conn(self, actor_id_hex: str, st: dict) -> RpcConnection:
         # Lock-free fast path: the connection exists for every call after
         # the first, and the IO loop is single-threaded, so a plain read is
@@ -1848,6 +1913,20 @@ class CoreWorker:
         async with st["lock"]:
             if st["conn"] is not None and not st["conn"].closed:
                 return st["conn"]
+            if not self._actor_events_subscribed:
+                # Arm restart fencing before the first dial so an actor
+                # that restarts later invalidates this cache (replayed
+                # across GCS reconnects by _on_gcs_reconnect).
+                self._actor_events_subscribed = True
+                self._subscriptions.setdefault("actors", []).insert(
+                    0, self._on_actor_event)
+                try:
+                    await self.gcs.request({"type": "subscribe",
+                                            "channel": "actors"})
+                except Exception:
+                    logger.warning("actor-events subscription failed; "
+                                   "restart fencing degraded",
+                                   exc_info=True)
             info = await self.gcs.request({"type": "wait_actor_state",
                                            "actor_id": actor_id_hex})
             if info is None:

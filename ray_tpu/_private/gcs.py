@@ -68,8 +68,24 @@ class NodeInfo:
     # Worst recent event-loop lag the raylet reported with its last
     # heartbeat (seconds); feeds the per-node health grace.
     reported_lag_s: float = 0.0
+    # Control-plane partition state: set when the node's conn dropped but
+    # the resurrection grace window (node_reconnect_grace_s) is still
+    # open.  The node stays alive (its workers/objects keep running on
+    # the far side of the partition) but is not schedulable; re-register
+    # clears it, grace expiry hands over to _mark_node_dead.
+    disconnected_at: Optional[float] = None
+    grace_task: Optional[asyncio.Task] = None
+    reconnects: int = 0
+
+    @property
+    def schedulable(self) -> bool:
+        # getattr: test harnesses stub conn with fakes that lack .closed.
+        return (self.alive and self.conn is not None
+                and not getattr(self.conn, "closed", False))
 
     def public(self) -> dict:
+        state = "DEAD" if not self.alive else (
+            "DISCONNECTED" if self.disconnected_at is not None else "ALIVE")
         return {
             "node_id": self.node_id.hex(),
             "address": self.address,
@@ -78,6 +94,7 @@ class NodeInfo:
             "resources_available": self.resources_available,
             "labels": self.labels,
             "alive": self.alive,
+            "state": state,
             "is_head": self.is_head,
             "pid": self.pid,
         }
@@ -373,8 +390,34 @@ class GcsServer:
             return   # clean shutdown closes every conn; nothing "died"
         for node in self.nodes.values():
             if node.conn is conn and node.alive:
-                logger.warning("node %s connection lost", node.node_id)
-                asyncio.get_event_loop().create_task(self._mark_node_dead(node))
+                self._on_node_disconnected(node)
+
+    def _on_node_disconnected(self, node: NodeInfo):
+        """A registered node's conn dropped.  The node's workers, plasma
+        store, and local leases are (as far as we know) still running on
+        the far side of a partition — so instead of the old immediate
+        _mark_node_dead (actor-restart storm for what may be a seconds-long
+        blip), hold the node DISCONNECTED for node_reconnect_grace_s.
+        Re-registration inside the window resurrects it with actors
+        intact; only expiry falls through to the death path."""
+        grace = _rt_config().node_reconnect_grace_s
+        node.conn = None
+        node.disconnected_at = time.monotonic()
+        logger.warning(
+            "node %s connection lost; holding DISCONNECTED for %.1fs "
+            "reconnect grace", node.node_id, grace)
+        asyncio.get_event_loop().create_task(self._publish(
+            "nodes", {"event": "disconnected", "node": node.public()}))
+
+        async def _grace_expiry():
+            await asyncio.sleep(grace)
+            if node.alive and node.disconnected_at is not None:
+                logger.warning(
+                    "node %s did not re-register within %.1fs grace; "
+                    "marking dead", node.node_id, grace)
+                await self._mark_node_dead(node)
+
+        node.grace_task = asyncio.get_event_loop().create_task(_grace_expiry())
 
     async def _publish(self, channel: str, data: dict):
         for conn in list(self.subscribers.get(channel, [])):
@@ -393,7 +436,8 @@ class GcsServer:
     # cluster-wide totals (see _mark_node_dead fold + util.state).
     _FOLDED_COUNTERS = ("spilled_objects", "restored_objects",
                         "objects_corrupted", "pull_retries",
-                        "spill_fsync_ms")
+                        "spill_fsync_ms", "gcs_reconnects",
+                        "node_disconnects", "resync_objects_readvertised")
 
     def dead_spill_totals(self) -> Dict[str, int]:
         """Aggregate spill/restore/integrity counters folded from dead
@@ -499,12 +543,17 @@ class GcsServer:
     # ------------------------------------------------------------------ nodes
 
     async def _h_register_node(self, conn, msg):
+        node_id = NodeID.from_hex(msg["node_id"])
+        existing = self.nodes.get(node_id)
+        if existing is not None and existing.alive:
+            return await self._resurrect_node(existing, conn, msg)
         node = NodeInfo(
-            node_id=NodeID.from_hex(msg["node_id"]),
+            node_id=node_id,
             address=msg["address"],
             store_name=msg["store_name"],
             resources_total=dict(msg["resources"]),
-            resources_available=dict(msg["resources"]),
+            resources_available=dict(
+                msg.get("resources_available", msg["resources"])),
             labels=msg.get("labels", {}),
             conn=conn,
             is_head=msg.get("is_head", False),
@@ -515,10 +564,117 @@ class GcsServer:
         # lifetime spill counters — keeping its folded entry would count
         # them twice in spill_totals().
         self._dead_spill_totals.pop(node.node_id.hex(), None)
+        # A raylet re-registering with a freshly-restarted GCS (snapshot
+        # restore forgot the node table) reports its live actors: claim
+        # them BEFORE _try_schedule_pending so a snapshot-restored
+        # detached actor is reconciled, not double-spawned.
+        stale = await self._reconcile_node_actors(node, msg.get("actors"))
         await self._publish("nodes", {"event": "alive", "node": node.public()})
         logger.info("node registered: %s at %s", node.node_id, node.address)
         await self._try_schedule_pending()
-        return {"ok": True, "num_nodes": len(self.nodes)}
+        return {"ok": True, "num_nodes": len(self.nodes),
+                "stale_actors": stale}
+
+    async def _resurrect_node(self, node: NodeInfo, conn, msg) -> dict:
+        """Idempotent re-registration of a known, still-alive node_id: the
+        partition healed inside the grace window (or the raylet noticed
+        `{"ok": False}` heartbeats and re-registered proactively).  No
+        actor-failure storm — actors the raylet still reports running keep
+        their state and num_restarts; nothing is dropped from
+        _dead_spill_totals because nothing was folded (the node never
+        died)."""
+        if node.grace_task is not None and not node.grace_task.done():
+            node.grace_task.cancel()
+        node.grace_task = None
+        was_disconnected = node.disconnected_at is not None
+        node.disconnected_at = None
+        node.conn = conn
+        node.address = msg["address"]
+        node.store_name = msg["store_name"]
+        node.resources_total = dict(msg["resources"])
+        if "resources_available" in msg:
+            # The raylet's availability view is authoritative (it owns the
+            # leases); absent one, keep ours — resetting to totals would
+            # leak the resources its still-running actors hold.
+            node.resources_available = dict(msg["resources_available"])
+        node.labels = msg.get("labels", node.labels)
+        node.is_head = msg.get("is_head", node.is_head)
+        node.pid = int(msg.get("pid", node.pid))
+        node.last_heartbeat = time.monotonic()
+        node.reconnects += 1
+        self._dead_spill_totals.pop(node.node_id.hex(), None)
+        stale = await self._reconcile_node_actors(node, msg.get("actors"))
+        await self._publish("nodes", {
+            "event": "reconnected" if was_disconnected else "alive",
+            "node": node.public()})
+        logger.info("node %s re-registered at %s (reconnect #%d)",
+                    node.node_id, node.address, node.reconnects)
+        await self._try_schedule_pending()
+        return {"ok": True, "num_nodes": len(self.nodes),
+                "reconnected": True, "stale_actors": stale}
+
+    async def _reconcile_node_actors(self, node: NodeInfo,
+                                     reported) -> List[str]:
+        """Align actor records with the raylet's authoritative liveness
+        list (``None`` from callers that don't report, e.g. drivers).
+
+        Two directions: (1) actors the raylet still runs become/stay ALIVE
+        here without burning a restart — in particular snapshot-restored
+        detached actors sitting RESTARTING in the pending queue are
+        claimed before _try_schedule_pending can spawn a duplicate;
+        (2) actors this GCS maps to the node that the raylet did NOT
+        report died during the partition with their death report lost —
+        they go through the normal failure/restart path now.
+
+        Returns the hex ids of reported actors this GCS will NOT honor —
+        killed while the node was unreachable, or already restarted on
+        another node after the grace window expired.  The raylet fences
+        those incarnations (kills the local workers): the cluster just
+        decided they don't exist, and leaving them running is split-brain
+        (a stale direct-transport handle could keep reaching them)."""
+        if reported is None:
+            return []
+        stale: List[str] = []
+        reported_by_id = {}
+        for rec in reported:
+            try:
+                reported_by_id[ActorID.from_hex(rec["actor_id"])] = rec
+            except Exception:
+                continue
+        for aid, rec in reported_by_id.items():
+            actor = self.actors.get(aid)
+            if actor is None or actor.state == DEAD:
+                stale.append(aid.hex())
+                continue
+            if actor.node_id is not None and actor.node_id != node.node_id:
+                # The actor moved while this node was unreachable (grace
+                # expired, restart landed elsewhere).  The reported copy
+                # is a zombie incarnation — do NOT yank the record back.
+                stale.append(aid.hex())
+                logger.warning(
+                    "actor %s reported by node %s but already lives on "
+                    "node %s; fencing the stale incarnation",
+                    aid, node.node_id, actor.node_id)
+                continue
+            actor.node_id = node.node_id
+            if rec.get("address"):
+                actor.address = rec["address"]
+            if aid in self._pending_actor_queue:
+                self._pending_actor_queue.remove(aid)
+            if actor.state != ALIVE:
+                actor.state = ALIVE
+                logger.info("actor %s reconciled ALIVE on node %s (no "
+                            "respawn)", aid, node.node_id)
+                self._wake_waiters(actor)
+                await self._publish(
+                    "actors", {"event": "alive", "actor": actor.public()})
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state == ALIVE \
+                    and actor.actor_id not in reported_by_id:
+                await self._on_actor_failure(
+                    actor,
+                    f"lost during node {node.node_id.hex()[:12]} partition")
+        return stale
 
     async def _h_heartbeat(self, conn, msg):
         node = self.nodes.get(NodeID.from_hex(msg["node_id"]))
@@ -598,6 +754,10 @@ class GcsServer:
                        if self._watchdog is not None else 0.0)
             cap = _rt_config().health_lag_grace_max_s
             for node in list(self.nodes.values()):
+                if node.disconnected_at is not None:
+                    # Conn is down, so heartbeats CANNOT arrive; the
+                    # reconnect grace timer owns this node's verdict.
+                    continue
                 # Grace for THEIR lag: a raylet that recently reported a
                 # big stall (spawn storm, /proc scan) earns its lag back.
                 # Both terms are capped — grace forgives transient lag,
@@ -616,6 +776,14 @@ class GcsServer:
         if not node.alive:
             return
         node.alive = False
+        # Cancel any pending resurrection grace (unless we ARE the grace
+        # expiry task — cancelling ourselves would abort this death
+        # half-done at the next await).
+        if node.grace_task is not None and not node.grace_task.done() \
+                and node.grace_task is not asyncio.current_task():
+            node.grace_task.cancel()
+        node.grace_task = None
+        node.disconnected_at = None
         # Drop its stats report: dead-node workers must neither linger in
         # the dashboard nor shadow reused pids in profile routing — but
         # fold its spill counters into the lifetime carry-over first.
@@ -703,18 +871,20 @@ class GcsServer:
                     idx = 0
                 nid = pg.allocations.get(idx)
                 node = self.nodes.get(nid) if nid else None
-                if node and node.alive:
+                if node and node.schedulable:
                     return node
             return None
         node_hex = scheduling.get("node_id")
         if node_hex:
             node = self.nodes.get(NodeID.from_hex(node_hex))
-            if node and node.alive and self._fits(node, resources):
+            if node and node.schedulable and self._fits(node, resources):
                 return node
             if not scheduling.get("soft", False):
                 return None
+        # DISCONNECTED nodes (alive, conn down) are not schedulable: a
+        # create/lease RPC has nowhere to go until the partition heals.
         candidates = [n for n in self.nodes.values()
-                      if n.alive and self._fits(n, resources)]
+                      if n.schedulable and self._fits(n, resources)]
         if not candidates:
             return None
         if scheduling.get("strategy") == "SPREAD":
@@ -949,7 +1119,7 @@ class GcsServer:
 
     async def _schedule_pg_inner(self, pg: PlacementGroupInfo):
         avail = {n.node_id: dict(n.resources_available)
-                 for n in self.nodes.values() if n.alive}
+                 for n in self.nodes.values() if n.schedulable}
         order = self._pg_node_order(pg, avail)
         placement: Dict[int, NodeID] = {}
 
@@ -1166,6 +1336,38 @@ class GcsServer:
         entry.spilled[msg["node_id"]] = msg["path"]
         entry.nodes.discard(msg["node_id"])
         return {"ok": True}
+
+    async def _h_resync_locations(self, conn, msg):
+        """Post-partition location resync: one batched re-advertisement of
+        every sealed in-memory copy and spill file a reconnecting raylet
+        holds, so the directory heals from any drops performed while the
+        node was unreachable (a >grace death dropped them all; a GCS
+        restart lost the whole directory).  Unlike _h_object_spilled,
+        an unknown spilled oid here must NOT be refused — refusal makes
+        the raylet delete the file, and after a directory loss every
+        entry is unknown.  Creates entries with owner "" (the owner
+        re-stamps on its next location_add), which is exactly what
+        _h_object_location_add does for unknown oids."""
+        nh = msg["node_id"]
+        added = 0
+        for oid in msg.get("objects", []):
+            entry = self.object_dir.get(oid)
+            if entry is None:
+                self.object_dir[oid] = ObjectDirEntry("", {nh})
+            else:
+                entry.nodes.add(nh)
+                entry.spilled.pop(nh, None)
+            added += 1
+        for oid, path in msg.get("spilled", {}).items():
+            entry = self.object_dir.get(oid)
+            if entry is None:
+                entry = self.object_dir[oid] = ObjectDirEntry("")
+            entry.spilled[nh] = path
+            added += 1
+        if added:
+            logger.info("node %s resynced %d object locations", nh[:12],
+                        added)
+        return {"ok": True, "count": added}
 
     async def _h_objects_on_node(self, conn, msg):
         """Plasma-resident object ids on a node (spill candidate listing)."""

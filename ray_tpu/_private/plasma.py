@@ -48,6 +48,10 @@ def _load():
         lib.store_delete_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.store_contains.restype = ctypes.c_int
         lib.store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.store_list_sealed.restype = ctypes.c_uint64
+        lib.store_list_sealed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_uint64,
+        ]
         lib.store_pointer.restype = ctypes.c_void_p
         lib.store_pointer.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         for name in ("store_capacity", "store_bytes_used", "store_num_objects",
@@ -222,6 +226,22 @@ class PlasmaClient:
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._h, object_id.binary()))
+
+    def list_sealed(self) -> List[bytes]:
+        """Binary ids of every sealed object currently in the store.
+
+        Drives the raylet's GCS resync: after a control-plane partition
+        heals, every local sealed copy is re-advertised so the object
+        directory recovers from any drops it performed while the node was
+        unreachable."""
+        max_ids = int(self.stats()["num_objects"]) + 64
+        while True:
+            buf = (ctypes.c_ubyte * (16 * max_ids))()
+            n = int(self._lib.store_list_sealed(self._h, buf, max_ids))
+            if n < max_ids:
+                raw = bytes(buf)
+                return [raw[i * 16:(i + 1) * 16] for i in range(n)]
+            max_ids *= 2
 
     # -- introspection --
 

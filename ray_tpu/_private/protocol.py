@@ -17,7 +17,9 @@ import asyncio
 import itertools
 import logging
 import pickle
+import random
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -68,6 +70,28 @@ def _maybe_install_env_fault() -> None:
             drop.get("conn", ""), int(drop.get("every", 0)))
 
 
+def _partition_window(name: str):
+    """(start, end) monotonic partition window for this conn name, or
+    None.  Consulted via util.fault_injection so in-process set_spec()
+    and the RT_FAULT_INJECTION env both take effect."""
+    try:
+        from ray_tpu.util import fault_injection
+    except Exception:
+        return None
+    if fault_injection.spec().partition is None:
+        return None
+    return fault_injection.partition_window(name)
+
+
+def _partition_active(name: str) -> bool:
+    win = _partition_window(name)
+    if win is None:
+        return False
+    start, end = win
+    now = time.monotonic()
+    return now >= start and (end is None or now < end)
+
+
 class RpcConnection:
     """A duplex request/reply + notify channel over one stream.
 
@@ -94,6 +118,7 @@ class RpcConnection:
         self._closed = False
         self.on_close: Optional[Callable[["RpcConnection"], None]] = None
         self._serve_task: Optional[asyncio.Task] = None
+        self._partition_task: Optional[asyncio.Task] = None
         # Outbox: small control messages queued within one loop tick leave
         # as a single _BATCH frame (one pickle, one write, one syscall)
         # instead of a frame each.  Bulk payloads (chunk transfer) bypass
@@ -103,7 +128,39 @@ class RpcConnection:
 
     def start(self):
         self._serve_task = asyncio.get_running_loop().create_task(self._serve())
+        self._maybe_schedule_partition()
         return self._serve_task
+
+    def _maybe_schedule_partition(self) -> None:
+        """Chaos hook: when a ``partition`` fault matches this connection's
+        name, abort the transport when the window opens (immediately if it
+        is already open).  A connection established after the window has
+        healed is left alone."""
+        win = _partition_window(self.name)
+        if win is None:
+            return
+        start, end = win
+        now = time.monotonic()
+        if end is not None and now >= end:
+            return  # window already healed
+        delay = max(0.0, start - now)
+
+        async def _abort():
+            if delay:
+                await asyncio.sleep(delay)
+            if self._closed:
+                return
+            logger.warning(
+                "fault injection: partitioning connection %s", self.name)
+            try:
+                self.writer.transport.abort()
+            except Exception:
+                try:
+                    self.writer.close()
+                except Exception:
+                    pass
+
+        self._partition_task = asyncio.get_running_loop().create_task(_abort())
 
     @property
     def closed(self) -> bool:
@@ -326,6 +383,8 @@ class RpcConnection:
         if self._closed:
             return
         self._closed = True
+        if self._partition_task is not None and not self._partition_task.done():
+            self._partition_task.cancel()
         for fut in list(self._pending.values()):
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"peer {self.name} disconnected"))
@@ -362,10 +421,201 @@ class RpcConnection:
         await self._shutdown()
 
 
+class ReconnectingConnection:
+    """A client connection that survives link loss by redialing.
+
+    Wraps one live RpcConnection at a time.  When the inner connection
+    drops, ``on_disconnect(self)`` fires synchronously and a background
+    redial loop starts: exponential backoff with jitter
+    (``backoff_base_s`` doubling to ``backoff_max_s``), every dial
+    bounded by ``dial_timeout_s``.  Requests and notifies issued while
+    the link is down fail fast with ConnectionLost — callers keep their
+    own retry semantics, exactly as with a plain connection.  After each
+    successful redial ``on_reconnect(self)`` runs (awaited when it
+    returns a coroutine) so the owner can replay session state the peer
+    keeps per-connection: re-register, re-subscribe, re-advertise object
+    locations.  ``reconnects`` counts successful redials.
+
+    Design analog: reference GcsRpcClient channel reconnection +
+    GcsClient re-subscribe-on-reconnect (src/ray/gcs/gcs_client).
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        handler: Optional[Callable[[dict], Awaitable[Any]]] = None,
+        name: str = "",
+        dial_timeout_s: float = 5.0,
+        backoff_base_s: float = 0.2,
+        backoff_max_s: float = 5.0,
+        on_reconnect: Optional[Callable[["ReconnectingConnection"], Any]] = None,
+        on_disconnect: Optional[Callable[["ReconnectingConnection"], None]] = None,
+    ):
+        self.addr = addr
+        self.handler = handler
+        self.name = name
+        self._dial_timeout_s = dial_timeout_s
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self.on_reconnect = on_reconnect
+        self.on_disconnect = on_disconnect
+        self.on_close: Optional[Callable[["ReconnectingConnection"], None]] = None
+        self._conn: Optional[RpcConnection] = None
+        self._closed = False
+        self._redial_task: Optional[asyncio.Task] = None
+        self.reconnects = 0
+
+    # -- dialing --
+
+    async def _dial_once(self) -> RpcConnection:
+        if _partition_active(self.name):
+            raise ConnectionLost(f"{self.name}: partition fault active")
+        if self.addr.startswith("unix://"):
+            dial = asyncio.open_unix_connection(self.addr[len("unix://"):])
+        else:
+            host, port = self.addr.rsplit(":", 1)
+            dial = asyncio.open_connection(host, int(port))
+        reader, writer = await asyncio.wait_for(dial, self._dial_timeout_s)
+        conn = RpcConnection(reader, writer, self.handler, name=self.name)
+        conn.on_close = self._on_inner_close
+        conn.start()
+        return conn
+
+    async def dial(self) -> None:
+        """Initial dial — strict (raises on failure) so a bad address or
+        down peer stays loud at startup; redials are the forgiving path."""
+        self._conn = await self._dial_once()
+
+    def _on_inner_close(self, conn: RpcConnection) -> None:
+        if self._conn is not conn:
+            return
+        self._conn = None
+        if self._closed:
+            return
+        if self.on_disconnect is not None:
+            try:
+                self.on_disconnect(self)
+            except Exception:
+                logger.exception("on_disconnect callback failed (%s)", self.name)
+        if self._redial_task is None or self._redial_task.done():
+            self._redial_task = asyncio.get_running_loop().create_task(
+                self._redial_loop())
+
+    async def _redial_loop(self) -> None:
+        backoff = self._backoff_base_s
+        while not self._closed:
+            # Jittered so a cluster's worth of raylets doesn't hammer a
+            # freshly-restarted GCS in lockstep.
+            await asyncio.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2, self._backoff_max_s)
+            if self._closed:
+                return
+            try:
+                conn = await self._dial_once()
+            except (OSError, ConnectionLost, asyncio.TimeoutError) as e:
+                logger.debug("redial %s failed: %r", self.name, e)
+                continue
+            self.reconnects += 1
+            self._conn = conn
+            if self.on_reconnect is not None:
+                try:
+                    res = self.on_reconnect(self)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception(
+                        "on_reconnect callback failed (%s)", self.name)
+            if self._conn is conn and not conn.closed:
+                logger.info("connection %s re-established (reconnect #%d)",
+                            self.name, self.reconnects)
+                return
+            # Dropped again mid-resync (_on_inner_close saw this task
+            # still running and spawned nothing) — keep dialing.
+
+    # -- RpcConnection-compatible surface --
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def connected(self) -> bool:
+        conn = self._conn
+        return conn is not None and not conn.closed
+
+    def _live(self) -> RpcConnection:
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        conn = self._conn
+        if conn is None or conn.closed:
+            raise ConnectionLost(f"{self.name}: link down (reconnecting)")
+        return conn
+
+    async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        return await self._live().request(msg, timeout)
+
+    async def notify(self, msg: dict):
+        await self._live().notify(msg)
+
+    def request_batch(self, msgs) -> "list[asyncio.Future]":
+        return self._live().request_batch(msgs)
+
+    async def maybe_drain(self) -> None:
+        conn = self._conn
+        if conn is not None and not conn.closed:
+            await conn.maybe_drain()
+
+    async def close(self):
+        self._closed = True
+        if self._redial_task is not None and not self._redial_task.done():
+            self._redial_task.cancel()
+            try:
+                await self._redial_task
+            except asyncio.CancelledError:
+                cur = asyncio.current_task()
+                if cur is not None and \
+                        getattr(cur, "cancelling", lambda: 0)() > 0:
+                    raise
+            except Exception:
+                pass
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            await conn.close()
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+
 async def connect(
-    addr: str, handler: Callable[[dict], Awaitable[Any]], name: str = ""
-) -> RpcConnection:
-    """addr is "host:port" for TCP or "unix://path"."""
+    addr: str,
+    handler: Callable[[dict], Awaitable[Any]],
+    name: str = "",
+    *,
+    reconnect: bool = False,
+    dial_timeout_s: float = 5.0,
+    backoff_base_s: float = 0.2,
+    backoff_max_s: float = 5.0,
+    on_reconnect: Optional[Callable[["ReconnectingConnection"], Any]] = None,
+    on_disconnect: Optional[Callable[["ReconnectingConnection"], None]] = None,
+):
+    """addr is "host:port" for TCP or "unix://path".
+
+    With ``reconnect=True`` returns a ReconnectingConnection (same call
+    surface) whose link self-heals after drops; the initial dial still
+    raises on failure."""
+    if reconnect:
+        rc = ReconnectingConnection(
+            addr, handler, name=name,
+            dial_timeout_s=dial_timeout_s,
+            backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s,
+            on_reconnect=on_reconnect,
+            on_disconnect=on_disconnect,
+        )
+        await rc.dial()
+        return rc
     if addr.startswith("unix://"):
         reader, writer = await asyncio.open_unix_connection(addr[len("unix://"):])
     else:

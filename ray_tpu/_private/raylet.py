@@ -40,7 +40,8 @@ from ray_tpu._private import object_transfer
 from ray_tpu._private.object_transfer import ChecksumError
 from ray_tpu._private import plasma as plasma_mod
 from ray_tpu._private.plasma import ObjectStoreFullError, PlasmaClient
-from ray_tpu._private.protocol import RpcConnection, RpcServer, connect
+from ray_tpu._private.protocol import (
+    ConnectionLost, RpcConnection, RpcServer, connect)
 
 logger = logging.getLogger(__name__)
 
@@ -180,6 +181,18 @@ class Raylet:
         self._objects_corrupted = 0
         self._pull_retries = 0
         self._spill_fsync_ms = 0.0
+        # Control-plane partition counters (node stats + /api/metrics):
+        # times the GCS link dropped, times it was re-established, and
+        # object locations re-advertised by post-reconnect resyncs.
+        self._node_disconnects = 0
+        self._gcs_reconnects = 0
+        self._resync_objects_readvertised = 0
+        # Heartbeat failure-logging epoch: one WARNING per disconnect
+        # epoch with a cumulative miss count, not one swallowed exception
+        # per period (and an INFO when beats resume).
+        self._hb_misses = 0
+        self._hb_epoch_warned = False
+        self._resync_lock = asyncio.Lock()
         # Test hook: replaces /proc/meminfo reads in the memory monitor.
         self._memory_usage_fn = None
         # CPU-worker forkserver (lazy; see _private/forkserver.py): one
@@ -199,20 +212,16 @@ class Raylet:
 
     async def start(self, port: int = 0) -> int:
         port = await self.server.start(port)
-        self.gcs_conn = await connect(self.gcs_address, self._handle_gcs_push,
-                                      name="raylet->gcs")
-        await self.gcs_conn.request({
-            "type": "register_node",
-            "node_id": self.node_id.hex(),
-            "address": self.server.address,
-            "store_name": self.store_name,
-            "resources": self.resources_total,
-            "labels": self.labels,
-            "is_head": self.is_head,
-            # Daemon pid: lets chaos tooling (util/fault_injection
-            # NodeKiller) target this node without out-of-band plumbing.
-            "pid": os.getpid(),
-        })
+        cfg = config()
+        self.gcs_conn = await connect(
+            self.gcs_address, self._handle_gcs_push, name="raylet->gcs",
+            reconnect=True,
+            dial_timeout_s=cfg.gcs_dial_timeout_s,
+            backoff_base_s=cfg.gcs_reconnect_backoff_base_s,
+            backoff_max_s=cfg.gcs_reconnect_backoff_max_s,
+            on_reconnect=self._on_gcs_reconnect,
+            on_disconnect=self._on_gcs_disconnect)
+        await self._register_with_gcs()
         # Liveness self-measurement: heartbeats ride this same loop, so
         # its lag IS the heartbeat delay (exported via node stats and
         # attached to each heartbeat for the GCS's health grace).
@@ -234,6 +243,104 @@ class Raylet:
         self._tasks.append(asyncio.get_running_loop().create_task(
             self._node_stats_loop()))
         return port
+
+    # ------------------------------------- GCS registration & resync
+
+    def _alive_actor_report(self) -> List[dict]:
+        """Actors still running on this node, reported with every
+        (re-)register so the GCS reconciles liveness instead of assuming
+        death.  The omission direction matters too: an actor the GCS maps
+        to us that this list lacks died while the link was down (its
+        death report was lost) and the GCS fails it on receipt."""
+        return [{"actor_id": w.actor_id, "address": w.address,
+                 "worker_id": w.worker_id.hex()}
+                for w in self.workers.values()
+                if w.actor_id is not None and w.actor_created
+                and w.proc.poll() is None]
+
+    async def _register_with_gcs(self) -> dict:
+        reply = await self.gcs_conn.request({
+            "type": "register_node",
+            "node_id": self.node_id.hex(),
+            "address": self.server.address,
+            "store_name": self.store_name,
+            "resources": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "is_head": self.is_head,
+            # Daemon pid: lets chaos tooling (util/fault_injection
+            # NodeKiller) target this node without out-of-band plumbing.
+            "pid": os.getpid(),
+            "actors": self._alive_actor_report(),
+        })
+        # Fencing: actors we reported that the GCS refuses (killed while
+        # the link was down, or restarted on another node after the grace
+        # window expired) are zombie incarnations — kill their workers so
+        # a stale direct-transport handle can't keep reaching them.
+        for aid in (reply or {}).get("stale_actors", []):
+            logger.warning(
+                "raylet %s: fencing stale actor %s (GCS reassigned it "
+                "while this node was unreachable)",
+                self.node_id.hex()[:12], aid[:12])
+            for w in list(self.workers.values()):
+                if w.actor_id == aid:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+        return reply
+
+    def _on_gcs_disconnect(self, conn) -> None:
+        """The GCS link dropped: DISCONNECTED degraded mode.  Local
+        leases, plasma, and object serving keep running (none of them
+        needs the GCS synchronously); GCS-backed calls fail fast with
+        ConnectionLost while the wrapped connection redials."""
+        self._node_disconnects += 1
+        self._hb_epoch_warned = False
+        logger.warning(
+            "raylet %s: GCS connection lost; entering DISCONNECTED "
+            "degraded mode (local leases/plasma/object serving continue; "
+            "redialing in background)", self.node_id.hex()[:12])
+
+    async def _on_gcs_reconnect(self, conn) -> None:
+        self._gcs_reconnects += 1
+        await self._resync_with_gcs()
+
+    async def _resync_with_gcs(self) -> None:
+        """Re-register under the SAME node_id and re-push authoritative
+        local state so the directory heals instead of serving stale
+        locations: available resources and alive actors ride the register
+        payload; every sealed in-memory object and every spill file goes
+        up in one batched resync_locations RPC (a >grace death dropped
+        our locations; a GCS restart lost the whole directory)."""
+        async with self._resync_lock:
+            await self._register_with_gcs()
+            objects = []
+            try:
+                objects = [ObjectID(b).hex()
+                           for b in self.plasma.list_sealed()]
+            except Exception:
+                logger.exception("resync: plasma listing failed")
+            spilled = {}
+            try:
+                for fname in os.listdir(self.spill_dir):
+                    if fname.endswith(".bin"):
+                        spilled[fname[:-len(".bin")]] = \
+                            os.path.join(self.spill_dir, fname)
+            except OSError:
+                pass
+            if objects or spilled:
+                r = await self.gcs_conn.request({
+                    "type": "resync_locations",
+                    "node_id": self.node_id.hex(),
+                    "objects": objects,
+                    "spilled": spilled,
+                })
+                self._resync_objects_readvertised += int(r.get("count", 0))
+            logger.info(
+                "raylet %s: resynced with GCS (%d in-memory + %d spilled "
+                "locations re-advertised)", self.node_id.hex()[:12],
+                len(objects), len(spilled))
 
     async def _publish_logs(self, batch: dict) -> None:
         if self.gcs_conn is not None:
@@ -366,6 +473,9 @@ class Raylet:
             "objects_corrupted": self._objects_corrupted,
             "pull_retries": self._pull_retries,
             "spill_fsync_ms": round(self._spill_fsync_ms, 3),
+            "gcs_reconnects": self._gcs_reconnects,
+            "node_disconnects": self._node_disconnects,
+            "resync_objects_readvertised": self._resync_objects_readvertised,
         }
         if self._watchdog is not None:
             out.update(self._watchdog.record())
@@ -419,7 +529,7 @@ class Raylet:
                 delay = fault_injection.heartbeat_delay_s()
                 if delay > 0:
                     await asyncio.sleep(delay)
-                await self.gcs_conn.request({
+                reply = await self.gcs_conn.request({
                     "type": "heartbeat",
                     "node_id": self.node_id.hex(),
                     "resources_available": self.resources_available,
@@ -437,8 +547,33 @@ class Raylet:
                             config().health_timeout_s) * 1000.0
                         if self._watchdog is not None else 0.0),
                 })
+                if self._hb_misses:
+                    logger.info(
+                        "raylet %s: heartbeats restored after %d missed "
+                        "beats", self.node_id.hex()[:12], self._hb_misses)
+                    self._hb_misses = 0
+                    self._hb_epoch_warned = False
+                if isinstance(reply, dict) and not reply.get("ok", True):
+                    # "GCS forgot me": a restarted GCS answers heartbeats
+                    # from nodes it no longer knows with ok=False.
+                    # Re-register + resync instead of heartbeating into
+                    # the void forever.
+                    logger.warning(
+                        "raylet %s: GCS does not know this node; "
+                        "re-registering", self.node_id.hex()[:12])
+                    await self._resync_with_gcs()
             except Exception:
-                pass
+                # One WARNING per disconnect epoch, not one swallowed
+                # exception per period — subsequent misses are counted
+                # and summarized by the restored-INFO above.
+                self._hb_misses += 1
+                if not self._hb_epoch_warned:
+                    self._hb_epoch_warned = True
+                    logger.warning(
+                        "raylet %s: heartbeat failed (miss #%d this "
+                        "epoch); suppressing until beats resume",
+                        self.node_id.hex()[:12], self._hb_misses,
+                        exc_info=True)
             await asyncio.sleep(config().heartbeat_period_s)
 
     async def _reap_loop(self):
@@ -778,7 +913,14 @@ class Raylet:
         now = time.monotonic()
         ts, nodes = getattr(self, "_node_view_cache", (0.0, None))
         if nodes is None or now - ts > config().node_view_cache_s:
-            nodes = await self.gcs_conn.request({"type": "get_nodes"})
+            try:
+                fresh = await self.gcs_conn.request({"type": "get_nodes"})
+            except ConnectionLost:
+                # DISCONNECTED degraded mode: a stale spill-scoring view
+                # (or none) beats failing the caller's lease — local
+                # scheduling must keep working without the GCS.
+                return nodes or []
+            nodes = fresh
             self._node_view_cache = (now, nodes)
         return nodes
 
@@ -1341,6 +1483,11 @@ class Raylet:
                 # reply {"ok": False} so the owner can decide, instead of
                 # leaking an unhandled exception out of the RPC handler.
                 return {"ok": False, "error": f"object store full: {e}"}
+            except ConnectionLost:
+                # DISCONNECTED degraded mode: the GCS link dropped mid-
+                # round.  Retriable like any other round failure — the
+                # reconnect may land before the retry budget runs out.
+                sealed, last_err = False, "GCS connection lost during pull"
             if sealed:
                 await self._register_pulled(oid_hex)
                 return {"ok": True}

@@ -43,9 +43,9 @@ class Cluster:
         self.head_node: Optional[ClusterNode] = None
         self.worker_nodes: List[ClusterNode] = []
         self.gcs_address: Optional[str] = None
+        self._head_args = dict(head_node_args or {})
         if initialize_head:
-            self.head_node = self._start_node(head=True,
-                                              **(head_node_args or {}))
+            self.head_node = self._start_node(head=True, **self._head_args)
             self.gcs_address = self.head_node.info["gcs_address"]
 
     @property
@@ -57,7 +57,8 @@ class Cluster:
                     object_store_memory: int = 256 * 1024 * 1024,
                     env: Optional[Dict[str, str]] = None,
                     labels: Optional[Dict[str, str]] = None,
-                    gcs_persist_path: Optional[str] = None) -> ClusterNode:
+                    gcs_persist_path: Optional[str] = None,
+                    gcs_port: int = 0) -> ClusterNode:
         ready_file = os.path.join(
             tempfile.gettempdir(),
             f"rt_node_{os.getpid()}_{uuid.uuid4().hex[:8]}.json")
@@ -74,6 +75,10 @@ class Cluster:
             cmd += ["--gcs-persist-path", gcs_persist_path]
         if head:
             cmd.append("--head")
+            if gcs_port:
+                # Fixed GCS port: a restarted head rebinds the same
+                # address, so surviving worker raylets can redial it.
+                cmd += ["--gcs-port", str(gcs_port)]
         else:
             cmd += ["--gcs-address", self.gcs_address]
         proc_env = dict(os.environ)
@@ -102,6 +107,20 @@ class Cluster:
                                 env=env, labels=labels)
         self.worker_nodes.append(node)
         return node
+
+    def restart_head(self) -> ClusterNode:
+        """Kill and restart the head daemon with its original args.
+
+        Meaningful for GCS fault-tolerance tests when the head was
+        started with an explicit ``gcs_port`` (same address after
+        restart) and a ``gcs_persist_path`` (durable tables survive);
+        surviving worker raylets then re-register over their
+        reconnecting GCS connections without a daemon respawn."""
+        assert self.head_node is not None, "cluster has no head"
+        self.head_node.kill()
+        self.head_node = self._start_node(head=True, **self._head_args)
+        self.gcs_address = self.head_node.info["gcs_address"]
+        return self.head_node
 
     def remove_node(self, node: ClusterNode):
         node.kill()

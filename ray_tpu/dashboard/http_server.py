@@ -213,9 +213,13 @@ class DashboardHttpServer:
         # stats, plus the GCS-side corruption strikes AGAINST each node
         # (these outlive the node — a holder that served garbage and died
         # is still part of the story).
+        # Control-plane partition counters ride the same stream: GCS
+        # redials, degraded-mode entries, and resync re-advertisements.
         for node_id, st in self.gcs.node_stats.items():
             for name in ("objects_corrupted", "pull_retries",
-                         "spill_fsync_ms"):
+                         "spill_fsync_ms", "gcs_reconnects",
+                         "node_disconnects",
+                         "resync_objects_readvertised"):
                 if name in st:
                     lag_records.append({
                         "name": name, "type": "counter",

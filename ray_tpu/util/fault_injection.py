@@ -56,6 +56,14 @@ class FaultSpec:
     # with an error reply (models a flaky holder; the puller's retry
     # rounds, not lineage, should absorb it).
     drop_fetch_reply: Optional[Any] = None
+    # partition: {"conn": substr, "after_s": N, "heal_s": M?} — a
+    # control-plane partition window: ``after_s`` seconds into the
+    # process's life, force-close (and refuse to redial) every connection
+    # whose name contains ``conn``; the window heals ``heal_s`` seconds
+    # later (omit heal_s for a permanent partition).  Exercises the
+    # reconnect/resurrection machinery end to end (protocol redial, GCS
+    # grace timer, raylet resync).
+    partition: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_env(cls) -> "FaultSpec":
@@ -73,6 +81,7 @@ class FaultSpec:
             corrupt_chunk=raw.get("corrupt_chunk"),
             truncate_spill=raw.get("truncate_spill"),
             drop_fetch_reply=raw.get("drop_fetch_reply"),
+            partition=raw.get("partition"),
         )
 
 
@@ -110,16 +119,18 @@ def spec() -> FaultSpec:
 def set_spec(**kwargs) -> FaultSpec:
     """In-process override for unit tests (does not touch the env, so
     subprocesses are unaffected).  Pair with clear_spec()."""
-    global _spec_cache
+    global _spec_cache, _partition_anchor
     _spec_cache = FaultSpec(**kwargs)
     _counters.clear()
+    _partition_anchor = None
     return _spec_cache
 
 
 def clear_spec() -> None:
-    global _spec_cache
+    global _spec_cache, _partition_anchor
     _spec_cache = None
     _counters.clear()
+    _partition_anchor = None
 
 
 def env_for(**kwargs) -> Dict[str, str]:
@@ -141,6 +152,40 @@ def forkserver_fault() -> Tuple[str, float]:
 def heartbeat_delay_s() -> float:
     """Extra delay injected before each raylet heartbeat."""
     return spec().heartbeat_delay_s
+
+
+_partition_anchor: Optional[float] = None
+
+
+def partition_window(conn_name: str) -> Optional[Tuple[float, Optional[float]]]:
+    """Absolute monotonic ``(start, end)`` of the partition window for
+    connections named ``conn_name``, or None when the active spec has no
+    partition fault matching it.  The window is anchored at the first
+    *matching* consultation in this process (connections dial during
+    daemon startup, so the anchor ≈ process start); ``end`` is None for a
+    heal-less (permanent) partition.  The protocol layer consults this
+    both to schedule the force-close of live matching connections and to
+    refuse redials while the window is open."""
+    global _partition_anchor
+    p = spec().partition
+    if not p or p.get("conn", "") not in (conn_name or ""):
+        return None
+    if _partition_anchor is None:
+        _partition_anchor = time.monotonic()
+    start = _partition_anchor + float(p.get("after_s", 0.0))
+    heal = p.get("heal_s")
+    return (start, None if heal is None else start + float(heal))
+
+
+def partition_active(conn_name: str) -> bool:
+    """True while ``conn_name`` is inside its partition window (dials must
+    fail)."""
+    win = partition_window(conn_name)
+    if win is None:
+        return False
+    start, end = win
+    now = time.monotonic()
+    return now >= start and (end is None or now < end)
 
 
 def make_drop_filter(conn_substr: str, every: int):

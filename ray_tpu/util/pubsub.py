@@ -5,8 +5,17 @@ GCS-hosted channels pushing node/actor lifecycle events to subscribed
 processes over their existing GCS connection (no extra sockets, matching the
 reference's long-poll-over-gRPC design in spirit).
 
-Channels currently published by the GCS: ``"nodes"`` ({event: alive|dead,
-node: {...}}) and ``"actors"`` ({event: alive|restarting|dead, actor: {...}}).
+Channels currently published by the GCS: ``"nodes"`` ({event:
+alive|disconnected|reconnected|dead, node: {...}}) and ``"actors"``
+({event: alive|restarting|dead, actor: {...}}).
+
+Subscriptions survive control-plane partitions: the GCS tracks
+subscribers per connection, and the core worker's reconnecting GCS
+connection replays every active channel subscription after a drop
+(see CoreWorker._on_gcs_reconnect), so callbacks resume without caller
+involvement.  Events published while the link was down are NOT
+replayed — subscribers needing a complete history must reconcile from
+authoritative state (e.g. ``util.state.list_nodes``) on reconnect.
 """
 
 from __future__ import annotations

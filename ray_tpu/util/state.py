@@ -94,6 +94,22 @@ def data_plane_totals() -> Dict[str, Any]:
     return out
 
 
+def control_plane_totals() -> Dict[str, Any]:
+    """Cluster-wide lifetime control-plane partition counters: successful
+    GCS redials (``gcs_reconnects``), entries into DISCONNECTED degraded
+    mode (``node_disconnects``), and object locations re-advertised by
+    post-reconnect resyncs (``resync_objects_readvertised``) — summed over
+    live nodes plus the dead-node carry-over."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    stats = reply.get("nodes", {})
+    dead = reply.get("dead_totals", {})
+    out: Dict[str, Any] = {}
+    for k in ("gcs_reconnects", "node_disconnects",
+              "resync_objects_readvertised"):
+        out[k] = dead.get(k, 0) + sum(s.get(k, 0) for s in stats.values())
+    return out
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects registered in the cluster object directory (plasma-sized;
     inline objects live in their owners and are not globally tracked)."""

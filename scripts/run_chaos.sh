@@ -10,13 +10,16 @@
 #            data-chaos - object data-plane faults only (chunk
 #                         corruption, torn spill files, dropped fetch
 #                         replies; -m "chaos and data_chaos")
+#            partition-chaos - control-plane partition faults only
+#                         (GCS connection loss, reconnect grace, head
+#                         restart; -m "chaos and partition_chaos")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
 PROFILE="all"
 case "${1:-}" in
-    all|data-chaos)
+    all|data-chaos|partition-chaos)
         PROFILE="$1"
         shift
         ;;
@@ -24,6 +27,8 @@ esac
 MARKER="chaos"
 if [ "$PROFILE" = "data-chaos" ]; then
     MARKER="chaos and data_chaos"
+elif [ "$PROFILE" = "partition-chaos" ]; then
+    MARKER="chaos and partition_chaos"
 fi
 
 RUNS="${CHAOS_RUNS:-3}"
