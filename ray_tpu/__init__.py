@@ -11,7 +11,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.object_ref import (ObjectRef,  # noqa: F401
-                                         ObjectRefGenerator)
+                                         ObjectRefGenerator,
+                                         StreamingObjectRefGenerator)
 from ray_tpu._private.worker import global_worker
 from ray_tpu.actor import (ActorClass, ActorHandle,  # noqa: F401
                            exit_actor, method)

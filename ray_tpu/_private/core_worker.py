@@ -28,6 +28,7 @@ Key protocol choices mirroring the reference:
 from __future__ import annotations
 
 import asyncio
+import collections
 import functools
 import hashlib
 import logging
@@ -175,6 +176,12 @@ class CoreWorker:
         self._cancel_refs: Dict[str, str] = {}
         # Pubsub: channel -> callbacks (reference pubsub/subscriber.h).
         self._subscriptions: Dict[str, list] = {}
+        # Streaming-generator consumer state (reference: ObjectRefStream
+        # in task_manager.h): task_id hex -> {queue, event, ref0,
+        # cancelled}.  Registered by the submit paths BEFORE scheduling so
+        # the first stream_yield can never beat it; popped on terminal
+        # (exhausted / error / cancel).
+        self._streams: Dict[str, dict] = {}
 
         self.plasma: Optional[PlasmaClient] = None
         if store_name:
@@ -352,6 +359,8 @@ class CoreWorker:
                 return await self._h_borrow_remove(msg)
             if mtype == "reconstruct_object":
                 return await self._h_reconstruct_object(msg)
+            if mtype == "stream_yield":
+                return await self._h_stream_yield(msg)
             if self.task_executor is not None:
                 return await self.task_executor.handle(conn, msg)
             raise ValueError(f"core worker: unknown message {mtype}")
@@ -426,6 +435,127 @@ class CoreWorker:
         # pulling node-to-node instead of streaming through the client
         # driver's (possibly WAN) link.
         return {"status": "plasma"}
+
+    # ---------------------------------------------------- streaming returns
+    #
+    # num_returns="streaming" protocol (reference: ObjectRefStream,
+    # task_manager.h + ReportGeneratorItemReturns): the executor sends one
+    # stream_yield RPC per yield and AWAITS the ack before stepping the
+    # generator again — the ack is the backpressure (one yield in flight),
+    # and a refused ack is the cancellation signal (the executor closes
+    # the user generator so its finally blocks run).  The final task reply
+    # still stores an ObjectRefGenerator at return-index 0, which doubles
+    # as the stream's completion marker: every yield ack completes before
+    # the final reply is sent, so ref0 appearing in the memory store
+    # strictly follows the last yield.
+
+    def register_stream(self, task_id_hex: str, ref0_hex: str) -> None:
+        """Create consumer state for a streaming call.  Called from the
+        submitting thread BEFORE the task is scheduled (dict assignment is
+        atomic under the GIL; the Event binds its loop lazily on first
+        wait, which happens on the IO loop)."""
+        self._streams[task_id_hex] = {
+            "queue": collections.deque(),
+            "event": asyncio.Event(),
+            "ref0": ref0_hex,
+            "cancelled": False,
+        }
+
+    async def _h_stream_yield(self, msg: dict):
+        """Owner-side adoption of one in-flight yield.  A missing or
+        cancelled stream refuses the yield — and frees the executor-side
+        copy, which nobody will ever reference — telling the producer to
+        stop."""
+        st = self._streams.get(msg["task_id"])
+        oid_hex, kind, data = msg["entry"]
+        if st is None or st["cancelled"]:
+            if kind != "inline":
+                asyncio.ensure_future(self.gcs.notify(
+                    {"type": "object_freed", "object_id": oid_hex}))
+            return {"ok": False, "cancelled": True}
+        self.owned.add(oid_hex)
+        self._store_local(oid_hex, "val" if kind == "inline" else "plasma",
+                          data)
+        ref = ObjectRef(ObjectID.from_hex(oid_hex), self.address)
+        st["queue"].append(ref)
+        st["event"].set()
+        return {"ok": True}
+
+    async def stream_next_async(self, task_id_hex: str,
+                                timeout: Optional[float] = None):
+        """Next yielded ObjectRef of a streaming call; StopAsyncIteration
+        when the producer finished (or the stream was cancelled), the
+        task's error if it failed mid-stream.  Runs on the IO loop."""
+        st = self._streams.get(task_id_hex)
+        if st is None:
+            raise StopAsyncIteration
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if st["queue"]:
+                return st["queue"].popleft()
+            if st["cancelled"]:
+                raise StopAsyncIteration
+            # Terminal check AFTER draining: the producer only stores ref0
+            # once every yield has been acked, so a present ref0 with an
+            # empty queue means the stream is fully consumed.
+            entry = self.memory_store.get(st["ref0"])
+            if entry is not None:
+                self._streams.pop(task_id_hex, None)
+                if entry[0] == "err":
+                    self._materialize(entry)   # raises the task's error
+                raise StopAsyncIteration
+            st["event"].clear()
+            ev0 = self.object_events.setdefault(st["ref0"], asyncio.Event())
+            waiters = [asyncio.ensure_future(st["event"].wait()),
+                       asyncio.ensure_future(ev0.wait())]
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            try:
+                done, pending = await asyncio.wait(
+                    waiters, timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for w in waiters:
+                    if not w.done():
+                        w.cancel()
+            if not done:
+                raise rex.GetTimeoutError(
+                    f"stream {task_id_hex[:16]} produced nothing for "
+                    f"{timeout}s")
+
+    def stream_next(self, task_id_hex: str,
+                    timeout: Optional[float] = None):
+        """Blocking stream_next for non-loop threads (drivers)."""
+        if threading.current_thread() is self._loop_thread:
+            raise RuntimeError(
+                "stream_next would deadlock the IO loop; use `async for` "
+                "on the generator instead")
+        return self._run(self.stream_next_async(task_id_hex, timeout))
+
+    def cancel_stream(self, task_id_hex: str, ref0: Optional[ObjectRef] = None):
+        """Consumer-side stream teardown (explicit cancel or handle GC):
+        drop queued refs (freeing their objects), refuse all future
+        yields, and best-effort cancel the producer task so a generator
+        stalled between yields doesn't hold its worker forever.  Safe
+        from any thread, including during interpreter teardown."""
+        def _do():
+            st = self._streams.pop(task_id_hex, None)
+            if st is None:
+                return
+            st["cancelled"] = True
+            st["queue"].clear()   # refs GC -> remove_local_ref -> free
+            st["event"].set()
+        try:
+            if self.loop.is_closed():
+                return
+            self.loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            return
+        if ref0 is not None:
+            try:
+                self.cancel_task(ref0)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ refcounts
 
@@ -1104,11 +1234,15 @@ class CoreWorker:
         # _raylet.pyx dynamic returns): the caller pre-owns only return 0
         # — an ObjectRefGenerator listing per-yield refs the executor
         # creates at indices 1..n; ownership of those registers when the
-        # reply arrives (_store_task_returns).
-        n_pre = 1 if num_returns == "dynamic" else num_returns
+        # reply arrives (_store_task_returns).  "streaming" pre-owns the
+        # same single ref but yields are adopted one at a time as
+        # stream_yield RPCs land, consumable before the task finishes.
+        n_pre = 1 if num_returns in ("dynamic", "streaming") else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(n_pre)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
+        if num_returns == "streaming":
+            self.register_stream(task_id.hex(), return_ids[0].hex())
         spec = {
             "task_id": task_id.hex(),
             "name": name or getattr(func, "__name__", "task"),
@@ -1174,6 +1308,9 @@ class CoreWorker:
             t.add_done_callback(_done)
 
         self.loop.call_soon_threadsafe(_kick)
+        if num_returns == "streaming":
+            return [object_ref_mod.StreamingObjectRefGenerator(
+                task_id.hex(), refs[0])]
         return refs
 
     def cancel_task(self, ref, force: bool = False) -> bool:
@@ -1549,7 +1686,17 @@ class CoreWorker:
                 and return_ids[0].hex() not in self.owned:
             # Caller freed the generator ref before the reply arrived:
             # adopting the per-yield extras now would leave them owned
-            # with no reachable ref (permanent leak).  Drop them instead.
+            # with no reachable ref.  Drop them — and free their backing
+            # copies: each non-inline extra has a plasma copy on the
+            # executor's node plus a GCS directory entry that nothing
+            # will ever release otherwise (same fan-out _free_object
+            # uses; the GCS forwards the free to every holder raylet).
+            for oid_hex, kind, _data in entries[len(return_ids):]:
+                if kind != "inline":
+                    asyncio.ensure_future(
+                        self.gcs.notify({"type": "object_freed",
+                                         "object_id": oid_hex}),
+                        loop=self.loop)
             entries = entries[:len(return_ids)]
         for oid_hex, kind, data in entries[len(return_ids):]:
             self.owned.add(oid_hex)
@@ -1644,12 +1791,14 @@ class CoreWorker:
                           concurrency_group=None) -> List[ObjectRef]:
         task_id = task_id_generator.next()
         s_args, s_kwargs, pinned_args = self.serialize_args(args, kwargs)
-        n_pre = 1 if num_returns == "dynamic" else num_returns
+        n_pre = 1 if num_returns in ("dynamic", "streaming") else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(n_pre)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
         for oid in return_ids:
             self.owned.add(oid.hex())
+        if num_returns == "streaming":
+            self.register_stream(task_id.hex(), return_ids[0].hex())
         call = {
             "type": "actor_call",
             "call_id": task_id.hex(),
@@ -1681,6 +1830,9 @@ class CoreWorker:
             self._submit_scheduled = True
         if wake:
             self.loop.call_soon_threadsafe(self._flush_submits)
+        if num_returns == "streaming":
+            return [object_ref_mod.StreamingObjectRefGenerator(
+                task_id.hex(), refs[0])]
         return refs
 
     def _flush_submits(self):

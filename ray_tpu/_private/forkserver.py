@@ -110,6 +110,11 @@ def _child(srv: socket.socket, req: dict) -> None:
         signal.signal(signal.SIGCHLD, signal.SIG_DFL)
         os.environ.clear()
         os.environ.update(req["env"])
+        # The template's forkserver_fault() probe populated the fault-spec
+        # cache from the TEMPLATE's env; drop it so this worker re-reads
+        # RT_FAULT_INJECTION from its own (possibly fault-carrying) env.
+        from ray_tpu.util import fault_injection
+        fault_injection.clear_spec()
         out = open(req["out"], "ab", buffering=0)
         err = open(req["err"], "ab", buffering=0)
         os.dup2(out.fileno(), 1)

@@ -72,6 +72,104 @@ class ObjectRefGenerator:
         return f"ObjectRefGenerator({len(self._refs)} refs)"
 
 
+class StreamingObjectRefGenerator:
+    """Handle to a ``num_returns="streaming"`` call (reference:
+    ObjectRefStream / StreamingObjectRefGenerator in _raylet.pyx): an
+    iterator of per-yield ObjectRefs that become consumable **while the
+    producer task is still running** — the executor advertises each yield
+    to the owner as it happens instead of batching refs into the final
+    reply.
+
+    ``async for ref in gen`` works on any asyncio loop; plain ``for ref
+    in gen`` works from any non-core-loop thread.  ``gen.completed()``
+    is the task's return-0 ref — it resolves to an ObjectRefGenerator of
+    every yielded ref once the producer finishes, or raises the task's
+    error.  ``gen.cancel()`` (also fired from ``__del__`` when the
+    handle is dropped mid-stream) stops the producer: its next yield is
+    refused by the owner, which closes the user generator so ``finally``
+    blocks run and release whatever the stream held.
+
+    The handle is owner-local and deliberately unpicklable — forward the
+    consumed values, not the stream."""
+
+    def __init__(self, task_id_hex: str, ref0: "ObjectRef"):
+        self._task_id = task_id_hex
+        self._ref0 = ref0
+        self._exhausted = False
+
+    @staticmethod
+    def _core():
+        from ray_tpu._private.worker import global_worker
+        return global_worker.core_worker
+
+    # ---- async iteration (primary API) ----
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._exhausted:
+            raise StopAsyncIteration
+        import asyncio
+        core = self._core()
+        coro = core.stream_next_async(self._task_id)
+        try:
+            if asyncio.get_running_loop() is core.loop:
+                return await coro
+            fut = asyncio.run_coroutine_threadsafe(coro, core.loop)
+            return await asyncio.wrap_future(fut)
+        except StopAsyncIteration:
+            self._exhausted = True
+            raise
+
+    # ---- sync iteration (driver threads) ----
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        try:
+            return self._core().stream_next(self._task_id)
+        except StopAsyncIteration:
+            self._exhausted = True
+            raise StopIteration from None
+
+    # ---- lifecycle ----
+
+    def completed(self) -> "ObjectRef":
+        """Ref of the task's terminal result: an ObjectRefGenerator of
+        all yielded refs on success, the task's error otherwise."""
+        return self._ref0
+
+    def task_id(self) -> str:
+        return self._task_id
+
+    def cancel(self):
+        """Stop consuming AND stop the producer (best effort)."""
+        self._exhausted = True
+        try:
+            self._core().cancel_stream(self._task_id, self._ref0)
+        except Exception:
+            pass
+
+    def __del__(self):
+        if not self._exhausted:
+            try:
+                self.cancel()
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "StreamingObjectRefGenerator is owner-local and cannot be "
+            "pickled; consume the stream and forward the values instead")
+
+    def __repr__(self):
+        return f"StreamingObjectRefGenerator({self._task_id[:16]})"
+
+
 class ObjectRef:
     __slots__ = ("id", "owner_address", "__weakref__")
 

@@ -50,6 +50,10 @@ class TaskExecutor:
         # (a call parked in the pool queue is still cancellable).
         self._actor_call_tasks: dict = {}
         self._sync_started: set = set()
+        # call_ids currently in the streaming-yield phase: the user body
+        # is parked at a yield (not mutating actor state mid-statement),
+        # so cancel may interrupt even though the sync body "started".
+        self._streaming_calls: set = set()
 
     def _cancel_task(self, msg: dict) -> dict:
         """Best-effort in-flight cancel (reference core_worker.cc
@@ -66,7 +70,7 @@ class TaskExecutor:
         # actor calls: cancellable unless the sync body already runs
         t = self._actor_call_tasks.get(tid)
         if t is not None:
-            if tid in self._sync_started:
+            if tid in self._sync_started and tid not in self._streaming_calls:
                 return {"ok": True, "not_cancellable": True}
             t.cancel()
             return {"ok": True}
@@ -258,6 +262,8 @@ class TaskExecutor:
         num_returns = spec["num_returns"]
         if num_returns == "dynamic":
             return await self._pack_dynamic_returns(spec, result)
+        if num_returns == "streaming":
+            return await self._pack_streaming_returns(spec, result)
         if num_returns == 1:
             results = [result]
         else:
@@ -301,6 +307,105 @@ class TaskExecutor:
         ser = self.core.ser.serialize(ObjectRefGenerator(refs))
         entry0 = await self.core.store_return_value_async(gen_oid, ser)
         return {"ok": True, "returns": [entry0] + entries}
+
+    async def _pack_streaming_returns(self, spec: dict, result) -> dict:
+        """Streaming generator call (num_returns="streaming", reference:
+        ReportGeneratorItemReturns in core_worker.cc): each yield is
+        stored AND advertised to the owner immediately via a stream_yield
+        RPC, so the consumer iterates while the generator still runs.
+
+        Awaiting every ack before the next step is the backpressure (one
+        yield in flight per stream); a refused ack means the consumer
+        dropped the stream, and close() raises GeneratorExit inside the
+        user body so its finally blocks release whatever the sequence
+        held.  The final reply stays shape-compatible with dynamic
+        returns: an ObjectRefGenerator of all yielded refs at index 0,
+        whose arrival in the owner's store doubles as the end-of-stream
+        marker (it strictly follows the last acked yield)."""
+        from ray_tpu._private.ids import TaskID
+        from ray_tpu._private.object_ref import (ObjectRef,
+                                                 ObjectRefGenerator)
+        task_id_hex = spec.get("call_id") or spec["task_id"]
+        task_id = TaskID(bytes.fromhex(task_id_hex))
+        owner = spec.get("owner_address", "")
+        if not owner:
+            raise ValueError(
+                'num_returns="streaming" requires an owner_address in the '
+                "task spec")
+        conn = await self.core._get_worker_conn(owner)
+        sentinel = object()
+        if hasattr(result, "__anext__"):
+            async def step():
+                try:
+                    return await result.__anext__()
+                except StopAsyncIteration:
+                    return sentinel
+
+            async def close():
+                await result.aclose()
+        elif hasattr(result, "__iter__"):
+            it = iter(result)
+
+            # next() runs on the exec thread (user code may block); the
+            # sentinel keeps StopIteration from crossing the coroutine
+            # boundary, where Python would morph it into RuntimeError.
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return sentinel
+
+            async def step():
+                return await self.core.exec_pool.run(_next)
+
+            async def close():
+                if hasattr(it, "close"):
+                    await self.core.exec_pool.run(it.close)
+        else:
+            raise TypeError(
+                'num_returns="streaming" requires the task to return a '
+                f"generator or async generator, got {type(result).__name__}")
+        self._streaming_calls.add(task_id_hex)
+        refs = []
+        i = 0
+        try:
+            while True:
+                try:
+                    value = await step()
+                except asyncio.CancelledError:
+                    # ray_tpu.cancel() mid-stream: close the user body so
+                    # its finally blocks run, then let the cancel reply
+                    # path take over.
+                    try:
+                        await close()
+                    except Exception:
+                        pass
+                    raise
+                if value is sentinel:
+                    break
+                i += 1
+                oid = ObjectID.for_task_return(task_id, i)
+                ser = self.core.ser.serialize(value)
+                entry = await self.core.store_return_value_async(oid, ser)
+                try:
+                    ack = await conn.request(
+                        {"type": "stream_yield", "task_id": task_id_hex,
+                         "index": i, "entry": entry}, timeout=60)
+                except Exception:
+                    ack = {"ok": False}   # owner died/unreachable: stop
+                if not ack.get("ok"):
+                    try:
+                        await close()
+                    except Exception:
+                        pass
+                    break
+                refs.append(ObjectRef(oid, owner))
+        finally:
+            self._streaming_calls.discard(task_id_hex)
+        gen_oid = ObjectID.for_task_return(task_id, 0)
+        ser = self.core.ser.serialize(ObjectRefGenerator(refs))
+        entry0 = await self.core.store_return_value_async(gen_oid, ser)
+        return {"ok": True, "returns": [entry0], "streamed": i}
 
     # -- actors --
 
@@ -428,7 +533,8 @@ class TaskExecutor:
                 self._advance(order, seq)
                 result = await fut
             spec = {"num_returns": msg["num_returns"], "task_id": msg["call_id"],
-                    "call_id": msg["call_id"]}
+                    "call_id": msg["call_id"],
+                    "owner_address": msg.get("owner_address", "")}
             await self.core.flush_borrow_acks()
             return await self._pack_returns(spec, result)
         except SystemExit:

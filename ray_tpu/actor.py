@@ -47,7 +47,7 @@ class ActorMethod:
             self._handle._actor_id_hex, self._method_name, args, kwargs,
             num_returns=self._num_returns,
             concurrency_group=self._concurrency_group)
-        if self._num_returns == 1 or self._num_returns == "dynamic":
+        if self._num_returns in (1, "dynamic", "streaming"):
             return refs[0]
         return refs
 

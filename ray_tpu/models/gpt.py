@@ -364,6 +364,116 @@ def gpt_forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPTConfig,
     return logits
 
 
+# --------------------------------------------------------- paged decode
+#
+# Serving path (ray_tpu.serve.engine): decode reads K/V from the paged
+# pools of ops/paged_attention.py instead of re-running the prefix, so
+# one replica steps MANY sequences per forward at O(1) compute per
+# token.  The math mirrors _block's head-major branch exactly — with
+# cfg.dtype=float32 the paged greedy decode reproduces gpt_forward's
+# token-by-token argmax bit-for-bit, which the CPU equivalence tests
+# assert.
+
+
+def init_paged_cache(cfg: GPTConfig, num_pages: int, page_size: int,
+                     dtype: Any = None) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed per-layer K/V page pools, [L, N, P, page, H] (KV-head-major
+    within each layer, matching ops.paged_attention's layouts).  Page 0
+    is the scratch sink for padded/inactive writes — allocators must
+    never hand it out."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.num_layers, cfg.num_heads, num_pages, page_size,
+             cfg.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def gpt_prefill(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
+                length: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                page_table: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill ONE padded sequence: run the trunk densely, scatter every
+    layer's K/V into the sequence's pages, and return the next-token
+    logits at the last real position.
+
+    ``tokens`` [1, S] (S a multiple of the page size, S <= max_seq_len),
+    ``length`` scalar int32 true length, ``page_table`` [1, maxp];
+    ``k_pages``/``v_pages`` [L, N, P, page, H].  Padding positions write
+    to scratch page 0 (see ops.paged_attention.prefill_kv) and, being
+    causal, never influence positions < length.  Returns
+    (logits [1, V] f32, k_pages, v_pages)."""
+    from ray_tpu.ops.paged_attention import prefill_kv
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["wte"].astype(dt)[tokens] \
+        + params["wpe"].astype(dt)[:S][None]
+
+    def body(x, inp):
+        p, kp, vp = inp
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        qkv = jnp.einsum("bsd,dcnh->bcnsh", h, p["attn"]["wqkv"].astype(dt))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]        # [B, N, S, H]
+        kp, vp = prefill_kv(kp, vp, k[0], v[0], length, page_table[0])
+        o = _dense_causal_attention_bnsh(q, k, v)
+        o = jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+        x = x + o + p["attn"]["bo"].astype(dt)
+        h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        h = jnp.einsum("bsd,dm->bsm", h, p["mlp"]["wi"].astype(dt)) \
+            + p["mlp"]["bi"].astype(dt)
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("bsm,md->bsd", h, p["mlp"]["wo"].astype(dt)) \
+            + p["mlp"]["bo"].astype(dt)
+        return x + h, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    last = x[0, length - 1]                              # [D]
+    logits = jnp.einsum("d,vd->v", last,
+                        params["wte"].astype(dt)).astype(jnp.float32)
+    return logits[None], k_pages, v_pages
+
+
+def gpt_decode_step(params: Dict[str, Any], cfg: GPTConfig,
+                    token: jax.Array, pos: jax.Array, k_pages: jax.Array,
+                    v_pages: jax.Array, page_table: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for a BATCH of sequences against the paged cache.
+
+    ``token`` [B] int32 current tokens, ``pos`` [B] their positions,
+    ``page_table`` [B, maxp].  Writes each token's K/V at ``pos`` then
+    attends positions [0, pos] through the page tables — sequences of
+    different lengths batch freely, and inactive slots (pos 0, all-zero
+    page-table row) harmlessly churn scratch page 0.  Returns
+    (next-token logits [B, V] f32, k_pages, v_pages)."""
+    from ray_tpu.ops.paged_attention import append_kv, paged_attention
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[token] + params["wpe"].astype(dt)[pos]
+
+    def body(x, inp):
+        p, kp, vp = inp
+        h = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        qkv = jnp.einsum("bd,dcnh->bcnh", h, p["attn"]["wqkv"].astype(dt))
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [B, N, H]
+        kp, vp = append_kv(kp, vp, k_new, v_new, pos, page_table)
+        o = paged_attention(q, kp, vp, pos + 1, page_table)
+        o = jnp.einsum("bnh,nhd->bd", o, p["attn"]["wo"].astype(dt))
+        x = x + o + p["attn"]["bo"].astype(dt)
+        h = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        h = jnp.einsum("bd,dm->bm", h, p["mlp"]["wi"].astype(dt)) \
+            + p["mlp"]["bi"].astype(dt)
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("bm,md->bd", h, p["mlp"]["wo"].astype(dt)) \
+            + p["mlp"]["bo"].astype(dt)
+        return x + h, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = jnp.einsum("bd,vd->bv", x,
+                        params["wte"].astype(dt)).astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
 def gpt_loss(params, batch: Dict[str, jax.Array], cfg: GPTConfig,
              rules: Optional[LogicalAxisRules] = None, mesh=None,
              forward_fn: Optional[Callable] = None) -> jax.Array:

@@ -264,6 +264,111 @@ def llama_forward(params: Dict[str, Any], tokens: jax.Array,
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
 
 
+# ---------------------------------------------------------------------------
+# Paged KV-cache decode (serving path) — LLaMA variant of gpt.py's
+# init_paged_cache/gpt_prefill/gpt_decode_step.  GQA makes the pools
+# NKV-head-major (kv_heads, not heads), rope is applied at each token's
+# absolute position before the K is scattered (the pools hold POST-rope
+# keys, so decode attention is a plain dot against the cache), and the
+# math mirrors _block's grouped dense branch exactly — with
+# cfg.dtype=float32 paged greedy decode reproduces llama_forward's
+# token-by-token argmax, which the CPU equivalence tests assert.
+
+
+def llama_init_paged_cache(cfg: LlamaConfig, num_pages: int,
+                           page_size: int, dtype: Any = None):
+    """Zeroed per-layer K/V page pools, [L, NKV, P, page, H].  Page 0 is
+    the scratch sink for padded/inactive writes — allocators must never
+    hand it out."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size,
+             cfg.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def llama_prefill(params: Dict[str, Any], cfg: LlamaConfig,
+                  tokens: jax.Array, length: jax.Array,
+                  k_pages: jax.Array, v_pages: jax.Array,
+                  page_table: jax.Array):
+    """Prefill ONE padded sequence (see gpt_prefill): dense trunk,
+    per-layer post-rope K/V scattered into the sequence's pages, f32
+    next-token logits at position length-1.  ``tokens`` [1, S] with S a
+    multiple of the page size; ``page_table`` [1, maxp];
+    ``k_pages``/``v_pages`` [L, NKV, P, page, H]."""
+    from ray_tpu.ops.paged_attention import prefill_kv
+    dt = cfg.dtype
+    rep = cfg.num_heads // cfg.num_kv_heads
+    S = tokens.shape[1]
+    cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+    x = params["wte"].astype(dt)[tokens]
+
+    def body(x, inp):
+        p, kp, vp = inp
+        h = _rms_norm(x, p["ln1"]["scale"], cfg.rms_eps)
+        q = jnp.einsum("bsd,dnh->bnsh", h, p["attn"]["wq"].astype(dt))
+        kv = jnp.einsum("bsd,dcnh->bcnsh", h, p["attn"]["wkv"].astype(dt))
+        k, v = kv[:, 0], kv[:, 1]                        # [B, NKV, S, H]
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kp, vp = prefill_kv(kp, vp, k[0], v[0], length, page_table[0])
+        o = _dense_causal_attention_gqa(q, k, v, rep)
+        x = x + jnp.einsum("bnsh,nhd->bsd", o, p["attn"]["wo"].astype(dt))
+        h = _rms_norm(x, p["ln2"]["scale"], cfg.rms_eps)
+        gu = jnp.einsum("bsd,cdm->cbsm", h, p["mlp"]["wgu"].astype(dt))
+        h = jax.nn.silu(gu[0]) * gu[1]
+        return x + jnp.einsum("bsm,md->bsd", h,
+                              p["mlp"]["wd"].astype(dt)), (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    last = x[0, length - 1]                              # [D]
+    logits = jnp.einsum("d,dv->v", last,
+                        params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits[None], k_pages, v_pages
+
+
+def llama_decode_step(params: Dict[str, Any], cfg: LlamaConfig,
+                      token: jax.Array, pos: jax.Array,
+                      k_pages: jax.Array, v_pages: jax.Array,
+                      page_table: jax.Array):
+    """One decode step for a BATCH of sequences (see gpt_decode_step).
+    ``token``/``pos`` [B]; rope rotates q and the new K at each
+    sequence's absolute position; the paged attention's GQA grouping
+    keeps K/V at kv_heads width.  Inactive slots (pos 0, all-zero
+    page-table row) harmlessly churn scratch page 0."""
+    from ray_tpu.ops.paged_attention import append_kv, paged_attention
+    dt = cfg.dtype
+    cos_t, sin_t = rope_tables(cfg.max_seq_len, cfg.head_dim,
+                               cfg.rope_theta)
+    cos, sin = cos_t[pos][:, None], sin_t[pos][:, None]  # [B, 1, H/2]
+    x = params["wte"].astype(dt)[token]
+
+    def body(x, inp):
+        p, kp, vp = inp
+        h = _rms_norm(x, p["ln1"]["scale"], cfg.rms_eps)
+        q = jnp.einsum("bd,dnh->bnh", h, p["attn"]["wq"].astype(dt))
+        kv = jnp.einsum("bd,dcnh->bcnh", h, p["attn"]["wkv"].astype(dt))
+        k_new, v_new = kv[:, 0], kv[:, 1]                # [B, NKV, H]
+        q = apply_rope(q, cos, sin)
+        k_new = apply_rope(k_new, cos, sin)
+        kp, vp = append_kv(kp, vp, k_new, v_new, pos, page_table)
+        o = paged_attention(q, kp, vp, pos + 1, page_table)
+        x = x + jnp.einsum("bnh,nhd->bd", o, p["attn"]["wo"].astype(dt))
+        h = _rms_norm(x, p["ln2"]["scale"], cfg.rms_eps)
+        gu = jnp.einsum("bd,cdm->cbm", h, p["mlp"]["wgu"].astype(dt))
+        h = jax.nn.silu(gu[0]) * gu[1]
+        return x + jnp.einsum("bm,md->bd", h,
+                              p["mlp"]["wd"].astype(dt)), (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    logits = jnp.einsum("bd,dv->bv", x,
+                        params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, k_pages, v_pages
+
+
 def llama_loss(params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
                rules: Optional[LogicalAxisRules] = None,
                mesh=None) -> jax.Array:

@@ -9,6 +9,12 @@ mesh.
 """
 
 from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.paged_attention import (  # noqa: F401
+    append_kv,
+    paged_attention,
+    prefill_kv,
+    sharded_paged_attention,
+)
 from ray_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,

@@ -96,7 +96,7 @@ class RemoteFunction:
             scheduling=_build_scheduling(opts),
             name=opts["name"] or self._function.__name__,
         )
-        if opts["num_returns"] == 1 or opts["num_returns"] == "dynamic":
+        if opts["num_returns"] in (1, "dynamic", "streaming"):
             return refs[0]
         return refs
 

@@ -66,18 +66,23 @@ class Replica:
         # limit no matter how deep the real backlog is.
         self._sem = asyncio.Semaphore(max_concurrent_queries)
 
+    @staticmethod
+    def _resolve(fn):
+        import inspect
+        # Resolve a class instance to its bound __call__ so coroutine /
+        # generator detection sees the real function.
+        if (not inspect.isfunction(fn) and not inspect.ismethod(fn)
+                and callable(fn) and hasattr(fn, "__call__")):
+            fn = fn.__call__
+        return fn
+
     async def handle_request(self, args, kwargs, method: Optional[str] = None):
         import functools
-        import inspect
         self._outstanding += 1
         try:
             async with self._sem:
-                fn = self._fn if method is None else getattr(self._fn, method)
-                # Resolve a class instance to its bound __call__ so
-                # coroutine detection sees the real function.
-                if (not inspect.isfunction(fn) and not inspect.ismethod(fn)
-                        and callable(fn) and hasattr(fn, "__call__")):
-                    fn = fn.__call__
+                fn = self._resolve(
+                    self._fn if method is None else getattr(self._fn, method))
                 if asyncio.iscoroutinefunction(fn):
                     result = await fn(*args, **kwargs)
                 else:
@@ -88,7 +93,67 @@ class Replica:
                             None, functools.partial(fn, *args, **kwargs))
                     if asyncio.iscoroutine(result):
                         result = await result
+                # A generator-handler called through the unary path drains
+                # to a list — the raw generator object is replica-local
+                # and would fail to pickle into the reply.
+                if hasattr(result, "__anext__"):
+                    return [item async for item in result]
+                if hasattr(result, "__next__") and hasattr(result, "send"):
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None, list, result)
                 return result
+        finally:
+            self._outstanding -= 1
+
+    async def handle_stream(self, args, kwargs,
+                            method: Optional[str] = None):
+        """Streaming twin of handle_request: an async generator the owner
+        consumes per-item via ``num_returns="streaming"`` — the caller
+        sees each yield while the handler is still running.  Sync
+        generators are stepped on threads so they can block; plain
+        (non-generator) results degrade to a single-item stream.
+        ``_outstanding``/the semaphore span the WHOLE stream life, so
+        queue_len (the autoscaler signal) counts live streams, not just
+        call setup."""
+        import functools
+
+        from ray_tpu.util import fault_injection
+        self._outstanding += 1
+        try:
+            async with self._sem:
+                fn = self._resolve(
+                    self._fn if method is None else getattr(self._fn, method))
+                loop = asyncio.get_running_loop()
+                if asyncio.iscoroutinefunction(fn):
+                    result = await fn(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                if hasattr(result, "__anext__"):
+                    async for item in result:
+                        stall = fault_injection.stall_stream_s()
+                        if stall:
+                            await asyncio.sleep(stall)
+                        yield item
+                elif hasattr(result, "__next__") and hasattr(result, "send"):
+                    sentinel = object()
+                    _next = functools.partial(next, result, sentinel)
+                    try:
+                        while True:
+                            item = await loop.run_in_executor(None, _next)
+                            if item is sentinel:
+                                break
+                            stall = fault_injection.stall_stream_s()
+                            if stall:
+                                await asyncio.sleep(stall)
+                            yield item
+                    finally:
+                        close = getattr(result, "close", None)
+                        if close is not None:
+                            await loop.run_in_executor(None, close)
+                else:
+                    yield result
         finally:
             self._outstanding -= 1
 

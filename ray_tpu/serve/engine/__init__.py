@@ -1,0 +1,12 @@
+"""ray_tpu.serve.engine — streaming LLM inference engine.
+
+Continuous batching over a paged KV cache (vLLM-style iteration-level
+scheduling), streaming per-token results through serve's
+``num_returns="streaming"`` transport.  See engine.py for the loop and
+kv_cache.py for the page accounting.
+"""
+
+from ray_tpu.serve.engine.engine import (EngineConfig,  # noqa: F401
+                                         InferenceEngine, LLMServer)
+from ray_tpu.serve.engine.kv_cache import (PageAllocator,  # noqa: F401
+                                           table_row)

@@ -1,0 +1,338 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+Reference analogs: vLLM's LLMEngine/Scheduler (continuous batching,
+paged KV) and the reference repo's serve replicas; the model side is
+``models/gpt.py``/``models/llama.py``'s ``*_prefill``/``*_decode_step``
+paged entry points.
+
+The core loop is **iteration-level scheduling**: instead of batching
+whole requests (every sequence waits for the slowest), the engine admits
+and retires sequences *per decode step* — a new request joins the live
+batch at the next step boundary, a finished one frees its slot and pages
+immediately.  One replica therefore decodes up to ``max_batch``
+sequences per forward dispatch, each at its own position, with per-token
+results streamed to callers through per-sequence asyncio queues (the
+transport half — serve's ``handle_stream`` + ``num_returns="streaming"``
+— rides on those queues).
+
+Admission reserves the worst case ``ceil((prompt + max_new) / page)``
+pages up front (see kv_cache.py), so a sequence admitted is a sequence
+that finishes: the loop never preempts and never OOMs mid-decode.
+Prefill runs one sequence per dispatch (B=1, fixed padded shape);
+decode runs the whole batch (fixed shape [max_batch]) with inactive
+slots parked on scratch page 0.  Both are jitted once; dispatches run on
+a single-thread executor so the actor's event loop keeps serving
+admissions and cancellations while XLA computes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+import dataclasses
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.serve.engine.kv_cache import PageAllocator, table_row
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "gpt"                 # "gpt" | "llama"
+    model_config: Any = None           # GPTConfig/LlamaConfig; tiny default
+    page_size: int = 8
+    num_pages: int = 128               # pool size; page 0 is scratch
+    max_batch: int = 8                 # decode slots per step
+    max_prompt_len: int = 64           # multiple of page_size
+    max_new_tokens: int = 32           # per-request cap
+    eos_token: Optional[int] = None
+    dtype: Any = None                  # KV pool dtype (default: model's)
+
+
+class _Sequence:
+    __slots__ = ("prompt", "max_new", "pages", "row", "queue", "generated",
+                 "pos", "last_token", "cancelled", "slot", "prefilled")
+
+    def __init__(self, prompt: List[int], max_new: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.pages: List[int] = []
+        self.row: Optional[np.ndarray] = None
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.generated = 0
+        self.pos = len(prompt)         # next KV write position
+        self.last_token: Optional[int] = None
+        self.cancelled = False
+        self.slot: Optional[int] = None
+        self.prefilled = False
+
+
+class InferenceEngine:
+    """Paged continuous-batching engine; see module docstring."""
+
+    def __init__(self, config: EngineConfig, params: Any = None,
+                 rng_seed: int = 0):
+        import jax
+
+        cfg = config
+        if cfg.max_prompt_len % cfg.page_size:
+            raise ValueError("max_prompt_len must be a multiple of "
+                             f"page_size ({cfg.page_size})")
+        if cfg.model == "gpt":
+            from ray_tpu.models.gpt import (GPTConfig, gpt_decode_step,
+                                            gpt_init, gpt_prefill,
+                                            init_paged_cache)
+            mc = cfg.model_config or GPTConfig.tiny(
+                seq=cfg.max_prompt_len + cfg.max_new_tokens)
+            init_fn, prefill_fn, decode_fn = \
+                gpt_init, gpt_prefill, gpt_decode_step
+            cache_fn = lambda: init_paged_cache(   # noqa: E731
+                mc, cfg.num_pages, cfg.page_size, cfg.dtype)
+        elif cfg.model == "llama":
+            from ray_tpu.models.llama import (LlamaConfig,
+                                              llama_decode_step,
+                                              llama_init,
+                                              llama_init_paged_cache,
+                                              llama_prefill)
+            mc = cfg.model_config or LlamaConfig.tiny(
+                seq=cfg.max_prompt_len + cfg.max_new_tokens)
+            init_fn, prefill_fn, decode_fn = \
+                llama_init, llama_prefill, llama_decode_step
+            cache_fn = lambda: llama_init_paged_cache(   # noqa: E731
+                mc, cfg.num_pages, cfg.page_size, cfg.dtype)
+        else:
+            raise ValueError(f"unknown engine model '{cfg.model}'")
+        if mc.max_seq_len < cfg.max_prompt_len + cfg.max_new_tokens:
+            raise ValueError(
+                f"model max_seq_len {mc.max_seq_len} < max_prompt_len + "
+                f"max_new_tokens ({cfg.max_prompt_len + cfg.max_new_tokens})")
+
+        self.config = cfg
+        self.model_config = mc
+        self._params = params if params is not None else \
+            init_fn(jax.random.PRNGKey(rng_seed), mc)
+        self._k_pages, self._v_pages = cache_fn()
+        self._alloc = PageAllocator(cfg.num_pages)
+        self._maxp = -(-(cfg.max_prompt_len + cfg.max_new_tokens)
+                       // cfg.page_size)
+
+        # Jit with params/config closed over: one compile per entry
+        # point, shapes fixed ([1, max_prompt_len] prefill,
+        # [max_batch] decode), so the steady-state loop never re-traces.
+        def _prefill(tokens, length, kp, vp, pt):
+            return prefill_fn(self._params, mc, tokens, length, kp, vp, pt)
+
+        def _decode(token, pos, kp, vp, pt):
+            return decode_fn(self._params, mc, token, pos, kp, vp, pt)
+
+        self._prefill = jax.jit(_prefill)
+        self._decode = jax.jit(_decode)
+
+        self._waiting: collections.deque = collections.deque()
+        self._active: Dict[int, _Sequence] = {}   # slot -> sequence
+        self._free_slots: List[int] = list(range(cfg.max_batch - 1, -1, -1))
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._steps = 0
+        # Single lane for XLA dispatches: the device serializes anyway,
+        # and one lane keeps (k_pages, v_pages) updates ordered.
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="rt-engine")
+
+    # ------------------------------------------------------------- public
+
+    async def generate(self, tokens: Sequence[int],
+                       max_new_tokens: Optional[int] = None
+                       ) -> AsyncIterator[int]:
+        """Admit one sequence; yields generated token ids as they decode.
+        Closing the iterator early (client disconnect) cancels the
+        sequence and frees its pages at the next step boundary."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.config.max_prompt_len:
+            raise ValueError(f"prompt length {len(tokens)} exceeds "
+                             f"max_prompt_len {self.config.max_prompt_len}")
+        max_new = min(max_new_tokens or self.config.max_new_tokens,
+                      self.config.max_new_tokens)
+        self._ensure_loop()
+        seq = _Sequence(tokens, max_new)
+        self._waiting.append(seq)
+        self._wake.set()
+        try:
+            while True:
+                item = await seq.queue.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            seq.cancelled = True
+            self._wake.set()
+
+    def stats(self) -> Dict[str, int]:
+        return {"active": len(self._active), "waiting": len(self._waiting),
+                "free_pages": self._alloc.free_pages, "steps": self._steps}
+
+    def close(self):
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            self._loop_task = None
+        self._exec.shutdown(wait=False)
+
+    # ----------------------------------------------------------- internals
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._run_loop())
+
+    def _pages_needed(self, seq: _Sequence) -> int:
+        return -(-(len(seq.prompt) + seq.max_new) // self.config.page_size)
+
+    def _admit(self):
+        while self._waiting and self._free_slots:
+            seq = self._waiting[0]
+            if seq.cancelled:
+                self._waiting.popleft()
+                continue
+            need = self._pages_needed(seq)
+            if not self._alloc.can_alloc(need):
+                if not self._active:
+                    # Nothing will ever free up: the request exceeds the
+                    # whole pool.  Fail it instead of parking forever.
+                    self._waiting.popleft()
+                    seq.queue.put_nowait(MemoryError(
+                        f"request needs {need} KV pages, pool has "
+                        f"{self._alloc.free_pages} free and 0 active"))
+                    continue
+                break   # head-of-line waits for a retire
+            self._waiting.popleft()
+            seq.pages = self._alloc.alloc(need)
+            seq.row = table_row(seq.pages, self._maxp)
+            seq.slot = self._free_slots.pop()
+            self._active[seq.slot] = seq
+
+    def _retire(self, seq: _Sequence, done: bool = True):
+        self._active.pop(seq.slot, None)
+        self._free_slots.append(seq.slot)
+        seq.slot = None
+        if seq.pages:
+            self._alloc.free(seq.pages)
+            seq.pages = []
+        if done and not seq.cancelled:
+            seq.queue.put_nowait(_DONE)
+
+    def _push(self, seq: _Sequence, token: int) -> bool:
+        """Deliver one token; returns True when the sequence is finished
+        (EOS or max_new reached)."""
+        seq.generated += 1
+        seq.last_token = token
+        if not seq.cancelled:
+            seq.queue.put_nowait(token)
+        eos = self.config.eos_token
+        return seq.generated >= seq.max_new or \
+            (eos is not None and token == eos)
+
+    async def _run_loop(self):
+        import jax.numpy as jnp
+        loop = asyncio.get_running_loop()
+        cfg = self.config
+        S = cfg.max_prompt_len
+        while True:
+            try:
+                for seq in [s for s in self._active.values() if s.cancelled]:
+                    self._retire(seq, done=False)
+                self._admit()
+                if not self._active:
+                    if self._waiting:
+                        continue   # admission makes progress every pass
+                    self._wake.clear()
+                    # Re-check: generate() may have appended between the
+                    # test above and the clear.
+                    if not self._waiting:
+                        await self._wake.wait()
+                    continue
+
+                # Prefill new admissions one at a time (B=1, one shape).
+                for seq in [s for s in self._active.values()
+                            if not s.prefilled]:
+                    toks = np.zeros((1, S), np.int32)
+                    toks[0, : len(seq.prompt)] = seq.prompt
+                    def _run(seq=seq, toks=toks):
+                        logits, kp, vp = self._prefill(
+                            toks, np.int32(len(seq.prompt)),
+                            self._k_pages, self._v_pages, seq.row[None])
+                        return int(jnp.argmax(logits[0])), kp, vp
+                    tok, self._k_pages, self._v_pages = \
+                        await loop.run_in_executor(self._exec, _run)
+                    seq.prefilled = True
+                    if self._push(seq, tok) or seq.cancelled:
+                        self._retire(seq, done=not seq.cancelled)
+
+                if not self._active:
+                    continue
+                # One batched decode step over every live slot.  Inactive
+                # slots run token 0 at pos 0 against an all-zero table
+                # row — their writes land in scratch page 0.
+                token = np.zeros((cfg.max_batch,), np.int32)
+                pos = np.zeros((cfg.max_batch,), np.int32)
+                tables = np.zeros((cfg.max_batch, self._maxp), np.int32)
+                for slot, seq in self._active.items():
+                    token[slot] = seq.last_token
+                    pos[slot] = seq.pos
+                    tables[slot] = seq.row
+                def _step():
+                    logits, kp, vp = self._decode(
+                        token, pos, self._k_pages, self._v_pages, tables)
+                    return np.asarray(jnp.argmax(logits, axis=-1)), kp, vp
+                nxt, self._k_pages, self._v_pages = \
+                    await loop.run_in_executor(self._exec, _step)
+                self._steps += 1
+                for slot, seq in list(self._active.items()):
+                    seq.pos += 1
+                    if self._push(seq, int(nxt[slot])) or seq.cancelled:
+                        self._retire(seq, done=not seq.cancelled)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:   # noqa: BLE001
+                logger.exception("inference engine step failed")
+                for seq in list(self._active.values()):
+                    self._retire(seq, done=False)
+                    seq.queue.put_nowait(e)
+                while self._waiting:
+                    self._waiting.popleft().queue.put_nowait(e)
+
+
+class LLMServer:
+    """Ready-made serve deployment body around an InferenceEngine.
+
+    ``serve.deployment(LLMServer).bind(EngineConfig(...))`` gives an HTTP
+    +handle-callable token streamer: payloads are
+    ``{"tokens": [...], "max_new_tokens": N}``; the response is the
+    stream of generated token ids (a list for unary callers, per-token
+    SSE events through the streaming ingress)."""
+
+    def __init__(self, config: Optional[EngineConfig] = None,
+                 params: Any = None, **config_kwargs):
+        self._engine = InferenceEngine(config or EngineConfig(
+            **config_kwargs), params=params)
+
+    async def __call__(self, payload):
+        if not isinstance(payload, dict) or "tokens" not in payload:
+            raise ValueError(
+                'expected {"tokens": [...], "max_new_tokens": N}')
+        async for tok in self._engine.generate(
+                payload["tokens"], payload.get("max_new_tokens")):
+            yield tok
+
+    def stats(self) -> Dict[str, int]:
+        return self._engine.stats()

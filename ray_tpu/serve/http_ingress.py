@@ -1,4 +1,5 @@
-"""HTTP ingress actor: minimal asyncio HTTP/1.1 server routing to replicas.
+"""HTTP ingress actor: asyncio HTTP/1.1 server routing to replicas,
+with token-streaming responses.
 
 Reference analog: HTTPProxyActor + LongestPrefixRouter
 (_private/http_proxy.py:387,143).  No aiohttp/starlette in this image, so
@@ -12,6 +13,24 @@ async GCS lookup and replicas are called by awaiting their ObjectRefs.
 POST /<route_prefix>  body=JSON  ->  result of deployment(body)
 GET  /-/routes                   ->  route table
 GET  /-/healthz                  ->  "ok"
+
+**Streaming.**  A request with ``"stream": true`` in its JSON body (or
+``Accept: text/event-stream``) is routed through the replica's streaming
+path (``handle_stream`` + ``num_returns="streaming"``): the response is
+``Transfer-Encoding: chunked`` Server-Sent Events, one ``data:`` event
+per yielded item, flushed as produced — the client reads the first token
+while the replica is still generating.  The stream ends with an
+``event: end`` record and the chunked terminator; the connection stays
+keep-alive.  A client that disconnects (or stops reading past the write
+timeout) cancels the replica-side stream, which frees the engine's KV
+pages.
+
+**Self-protection.**  Connection storms are load-shed at accept time
+(429 + Retry-After once ``max_connections`` are live); malformed or
+oversized requests get clean 400/413s instead of a hung reader; every
+socket read and write is bounded by a timeout, with the slow-client
+fault hook (``util.fault_injection``) injected inside the drain so
+chaos tests can trip the write path deterministically.
 """
 
 from __future__ import annotations
@@ -20,14 +39,42 @@ import asyncio
 import itertools
 import json
 import logging
+import os
 from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+_MAX_HEADERS = 64
+
+
+async def _materialize(item):
+    from ray_tpu._private.object_ref import ObjectRef
+    if isinstance(item, ObjectRef):
+        return await item
+    return item
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _BadRequest(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
 
 class HTTPIngress:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 namespace: str = "default"):
+                 namespace: str = "default", *,
+                 max_connections: Optional[int] = None,
+                 max_body_bytes: Optional[int] = None,
+                 read_timeout_s: Optional[float] = None,
+                 write_timeout_s: Optional[float] = None,
+                 stream_idle_timeout_s: Optional[float] = None):
         self._host, self._port = host, port
         self._namespace = namespace
         self._server = None
@@ -35,6 +82,21 @@ class HTTPIngress:
         self._replicas: Dict[str, list] = {}
         self._rr = itertools.count()
         self._ctrl = None
+        self._nconn = 0
+        self._shed = 0          # connections 429'd (observability)
+        self._max_conn = int(max_connections if max_connections is not None
+                             else _env_f("RT_SERVE_MAX_CONNECTIONS", 256))
+        self._max_body = int(max_body_bytes if max_body_bytes is not None
+                             else _env_f("RT_SERVE_MAX_BODY_BYTES",
+                                         10 * 1024 * 1024))
+        self._read_timeout = (read_timeout_s if read_timeout_s is not None
+                              else _env_f("RT_SERVE_READ_TIMEOUT_S", 120.0))
+        self._write_timeout = (write_timeout_s
+                               if write_timeout_s is not None
+                               else _env_f("RT_SERVE_WRITE_TIMEOUT_S", 30.0))
+        self._stream_idle = (stream_idle_timeout_s
+                             if stream_idle_timeout_s is not None
+                             else _env_f("RT_SERVE_STREAM_IDLE_S", 120.0))
 
     async def _ensure_started(self):
         if self._server is not None:
@@ -47,6 +109,10 @@ class HTTPIngress:
     async def address(self) -> Tuple[str, int]:
         await self._ensure_started()
         return (self._host, self._port)
+
+    async def stats(self) -> Dict[str, int]:
+        return {"connections": self._nconn, "shed": self._shed,
+                "max_connections": self._max_conn}
 
     async def _controller(self):
         if self._ctrl is None:
@@ -73,58 +139,7 @@ class HTTPIngress:
                 self._ctrl = None  # controller restarted; re-resolve
             await asyncio.sleep(1.0)
 
-    async def _call(self, name: str, payload):
-        reps = self._replicas.get(name)
-        if not reps:
-            ctrl = await self._controller()
-            reps = self._replicas[name] = \
-                await ctrl.get_replicas.remote(name)
-        if not reps:
-            raise RuntimeError(f"deployment {name} has no replicas")
-        replica = reps[next(self._rr) % len(reps)]
-        return await replica.handle_request.remote([payload], {}, None)
-
-    async def _serve_conn(self, reader: asyncio.StreamReader,
-                          writer: asyncio.StreamWriter):
-        try:
-            while True:
-                line = await reader.readline()
-                if not line or line in (b"\r\n", b"\n"):
-                    return
-                try:
-                    method, path, _ = line.decode().split(" ", 2)
-                except ValueError:
-                    return await self._respond(writer, 400,
-                                               {"error": "bad request"})
-                headers = {}
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = h.decode().partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                body = b""
-                n = int(headers.get("content-length", 0) or 0)
-                if n:
-                    body = await reader.readexactly(n)
-                keep = headers.get("connection", "").lower() != "close"
-                await self._dispatch(writer, method, path, body)
-                if not keep:
-                    return
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
-        finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
-
-    async def _dispatch(self, writer, method: str, path: str, body: bytes):
-        path = path.split("?", 1)[0]  # health checks may append queries
-        if path == "/-/healthz":
-            return await self._respond(writer, 200, "ok")
-        if path == "/-/routes":
-            return await self._respond(writer, 200, self._routes)
+    def _match_route(self, path: str) -> Optional[str]:
         # Longest matching route prefix wins, on path-segment boundaries
         # (http_proxy.py:143 LongestPrefixRouter): /echo matches /echo and
         # /echo/x but not /echoes.
@@ -134,6 +149,139 @@ class HTTPIngress:
             p = prefix.rstrip("/")
             if (path == p or path.startswith(p + "/")) and len(p) > best:
                 target, best = name, len(p)
+        return target
+
+    async def _pick_replica(self, name: str):
+        reps = self._replicas.get(name)
+        if not reps:
+            ctrl = await self._controller()
+            reps = self._replicas[name] = \
+                await ctrl.get_replicas.remote(name)
+        if not reps:
+            raise RuntimeError(f"deployment {name} has no replicas")
+        return reps[next(self._rr) % len(reps)]
+
+    async def _call(self, name: str, payload):
+        replica = await self._pick_replica(name)
+        return await replica.handle_request.remote([payload], {}, None)
+
+    async def _call_stream(self, name: str, payload):
+        """StreamingObjectRefGenerator of the replica handler's yields."""
+        replica = await self._pick_replica(name)
+        return replica.handle_stream.options(
+            num_returns="streaming").remote([payload], {}, None)
+
+    # --------------------------------------------------------- connection
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        if self._nconn >= self._max_conn:
+            # Load shedding: a storm of connections must not starve the
+            # live ones (or the event loop).  Shed at accept with an
+            # explicit retry hint; /-/healthz stays responsive because
+            # established connections still serve.
+            self._shed += 1
+            try:
+                await self._respond(writer, 429,
+                                    {"error": "too many connections"},
+                                    extra_headers={"Retry-After": "1"},
+                                    close=True)
+            except Exception:
+                pass
+            finally:
+                writer.close()
+            return
+        self._nconn += 1
+        try:
+            while True:
+                try:
+                    line = await asyncio.wait_for(
+                        reader.readline(), self._read_timeout)
+                except (asyncio.TimeoutError, ValueError):
+                    return   # idle keep-alive or oversized request line
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, path, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    return await self._respond(
+                        writer, 400, {"error": "bad request"}, close=True)
+                try:
+                    headers, body = await self._read_request(reader)
+                except _BadRequest as e:
+                    # The body was not (fully) read: the connection can't
+                    # be reused safely, so answer and close.
+                    return await self._respond(
+                        writer, e.code, {"error": str(e)}, close=True)
+                except (asyncio.TimeoutError, ValueError,
+                        asyncio.IncompleteReadError):
+                    return   # client stopped mid-request: nothing to say
+                keep = headers.get("connection", "").lower() != "close"
+                await self._dispatch(writer, method, path, headers, body)
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, asyncio.TimeoutError):
+            pass
+        finally:
+            self._nconn -= 1
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader) -> Tuple[Dict[str, str], bytes]:
+        headers: Dict[str, str] = {}
+        for _ in range(_MAX_HEADERS):
+            h = await asyncio.wait_for(reader.readline(),
+                                       self._read_timeout)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, sep, v = h.decode("latin-1").partition(":")
+            if sep:
+                headers[k.strip().lower()] = v.strip()
+        else:
+            raise _BadRequest(400, "too many headers")
+        raw_n = headers.get("content-length", "0") or "0"
+        try:
+            n = int(raw_n)
+            if n < 0:
+                raise ValueError
+        except ValueError:
+            # A reader that trusted this value would hang waiting for a
+            # body that never comes (or worse, int("1e9")-style garbage).
+            raise _BadRequest(400,
+                              f"malformed content-length {raw_n!r}") from None
+        if n > self._max_body:
+            raise _BadRequest(413, f"body of {n} bytes exceeds limit "
+                                   f"{self._max_body}")
+        body = b""
+        if n:
+            body = await asyncio.wait_for(reader.readexactly(n),
+                                          self._read_timeout)
+        return headers, body
+
+    # ----------------------------------------------------------- dispatch
+
+    async def _dispatch(self, writer, method: str, path: str,
+                        headers: Dict[str, str], body: bytes):
+        path = path.split("?", 1)[0]  # health checks may append queries
+        if path == "/-/healthz":
+            return await self._respond(writer, 200, "ok")
+        if path == "/-/routes":
+            return await self._respond(writer, 200, self._routes)
+        target = self._match_route(path)
+        if target is None:
+            # Route-table miss: the background refresh runs on a 1s
+            # cadence, so a request racing a fresh serve.run (or a fresh
+            # ingress) would 404 spuriously.  Pull the table once,
+            # synchronously, before giving up.
+            try:
+                ctrl = await self._controller()
+                self._routes = await ctrl.routes.remote()
+            except Exception:
+                self._ctrl = None
+            target = self._match_route(path)
         if target is None:
             return await self._respond(writer, 404,
                                        {"error": f"no route for {path}"})
@@ -141,6 +289,11 @@ class HTTPIngress:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
             payload = body.decode("utf-8", "replace")
+        streaming = ("text/event-stream" in headers.get("accept", "")
+                     or (isinstance(payload, dict)
+                         and payload.get("stream") is True))
+        if streaming:
+            return await self._dispatch_stream(writer, target, payload)
         try:
             result = await self._call(target, payload)
             await self._respond(writer, 200, {"result": result})
@@ -148,15 +301,95 @@ class HTTPIngress:
             logger.exception("serve http: request to %s failed", target)
             await self._respond(writer, 500, {"error": repr(e)})
 
-    async def _respond(self, writer, code: int, payload):
+    async def _dispatch_stream(self, writer, target: str, payload):
+        """SSE token stream: chunked transfer, one data event per yield,
+        flushed as produced.  Client disconnect / write timeout / idle
+        stream all cancel the replica-side generator."""
+        try:
+            gen = await self._call_stream(target, payload)
+        except Exception as e:   # noqa: BLE001
+            logger.exception("serve http: stream to %s failed to start",
+                             target)
+            return await self._respond(writer, 500, {"error": repr(e)})
+        await self._write(
+            writer,
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n")
+        try:
+            while True:
+                try:
+                    # Each stream item is a per-yield ObjectRef (the
+                    # generator owner side of num_returns="streaming");
+                    # awaiting the ref materializes the token.
+                    item = await asyncio.wait_for(gen.__anext__(),
+                                                  self._stream_idle)
+                    item = await asyncio.wait_for(
+                        _materialize(item), self._stream_idle)
+                except StopAsyncIteration:
+                    await self._write_event(writer, "end", {})
+                    break
+                except asyncio.TimeoutError:
+                    gen.cancel()
+                    await self._write_event(
+                        writer, "error",
+                        {"error": f"stream idle for {self._stream_idle}s"})
+                    break
+                except Exception as e:   # noqa: BLE001 handler raised
+                    await self._write_event(writer, "error",
+                                            {"error": repr(e)})
+                    break
+                await self._write_event(writer, None, item)
+            await self._write(writer, b"0\r\n\r\n")   # chunk terminator
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.TimeoutError):
+            # Client gone (or reading too slowly): tear down the
+            # replica-side stream so the engine frees its KV pages.
+            gen.cancel()
+            raise
+
+    async def _write_event(self, writer, event: Optional[str], data):
+        payload = (f"event: {event}\n" if event else "") + \
+            "data: " + json.dumps(data, default=repr) + "\n\n"
+        raw = payload.encode()
+        await self._write(writer,
+                          f"{len(raw):x}\r\n".encode() + raw + b"\r\n")
+
+    async def _drain(self, writer):
+        from ray_tpu.util import fault_injection
+        delay = fault_injection.slow_client_delay_s()
+        if delay:
+            await asyncio.sleep(delay)
+        await writer.drain()
+
+    async def _write(self, writer, data: bytes):
+        """All socket writes funnel here: a client that stops reading
+        (full TCP window) parks drain(); the timeout converts that into
+        an abort instead of an ingress slot leaked forever."""
+        writer.write(data)
+        await asyncio.wait_for(self._drain(writer), self._write_timeout)
+
+    async def _respond(self, writer, code: int, payload,
+                       extra_headers: Optional[Dict[str, str]] = None,
+                       close: bool = False):
         if isinstance(payload, str):
             data = payload.encode()
             ctype = "text/plain"
         else:
             data = json.dumps(payload, default=repr).encode()
             ctype = "application/json"
-        writer.write(
-            f"HTTP/1.1 {code} {'OK' if code == 200 else 'ERR'}\r\n"
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large", 429: "Too Many Requests",
+                  500: "Internal Server Error"}.get(code, "ERR")
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
+        if close:
+            extra += "Connection: close\r\n"
+        await self._write(
+            writer,
+            f"HTTP/1.1 {code} {reason}\r\n"
             f"Content-Type: {ctype}\r\n"
-            f"Content-Length: {len(data)}\r\n\r\n".encode() + data)
-        await writer.drain()
+            f"Content-Length: {len(data)}\r\n{extra}\r\n".encode() + data)
+        if close:
+            writer.close()

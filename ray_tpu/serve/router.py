@@ -114,12 +114,32 @@ class DeploymentHandle:
             self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
         return replica.handle_request.remote(list(args), kwargs, _method)
 
+    def remote_stream(self, *args, _method: str = None, **kwargs):
+        """Route one STREAMING request: returns a
+        ``StreamingObjectRefGenerator`` whose items are the handler's
+        yields, consumable while the replica is still generating
+        (``async for`` it, or ``next()`` off-loop).  Dropping the
+        generator early cancels the replica-side stream."""
+        self._refresh()
+        replica = self._pick()
+        aid = replica._actor_id
+        now = time.monotonic()
+        with self._lock:
+            if now - self._counters_reset_at > COUNTER_RESET_PERIOD_S:
+                self._outstanding = {}
+                self._counters_reset_at = now
+            self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
+        return replica.handle_stream.options(
+            num_returns="streaming").remote(list(args), kwargs, _method)
+
     def method(self, name: str):
         """handle.method("encode").remote(...) calls a named method."""
         h = self
         class _M:  # noqa: N801 - tiny adapter
             def remote(self, *a, **k):
                 return h.remote(*a, _method=name, **k)
+            def remote_stream(self, *a, **k):
+                return h.remote_stream(*a, _method=name, **k)
         return _M()
 
 

@@ -56,6 +56,16 @@ class FaultSpec:
     # with an error reply (models a flaky holder; the puller's retry
     # rounds, not lineage, should absorb it).
     drop_fetch_reply: Optional[Any] = None
+    # Serve streaming faults (see serve/http_ingress.py and the Replica
+    # stream path):
+    # slow_client: {"delay_s": X} — stretch every ingress socket drain by
+    # X seconds (models a client reading slower than tokens are
+    # produced; drives the per-connection write timeout).
+    slow_client: Optional[Any] = None
+    # stall_stream: {"after": N, "stall_s": X} — the Nth streamed item
+    # this process yields is delayed X seconds (models a wedged decode
+    # step; drives the ingress stream-idle timeout).
+    stall_stream: Optional[Any] = None
     # partition: {"conn": substr, "after_s": N, "heal_s": M?} — a
     # control-plane partition window: ``after_s`` seconds into the
     # process's life, force-close (and refuse to redial) every connection
@@ -81,6 +91,8 @@ class FaultSpec:
             corrupt_chunk=raw.get("corrupt_chunk"),
             truncate_spill=raw.get("truncate_spill"),
             drop_fetch_reply=raw.get("drop_fetch_reply"),
+            slow_client=raw.get("slow_client"),
+            stall_stream=raw.get("stall_stream"),
             partition=raw.get("partition"),
         )
 
@@ -239,6 +251,37 @@ def truncate_spill(path: str) -> bool:
         return True
     except OSError:
         return False
+
+
+def slow_client_delay_s() -> float:
+    """Chaos hook in the ingress write path: seconds to stretch each
+    socket drain (0.0 = fault inactive).  Injected INSIDE the drain the
+    write timeout wraps, so a delay longer than the timeout
+    deterministically trips the slow-client abort."""
+    fault = spec().slow_client
+    if not fault:
+        return 0.0
+    if isinstance(fault, dict):
+        return float(fault.get("delay_s", 1.0))
+    return float(fault)
+
+
+def stall_stream_s() -> float:
+    """Chaos hook in the replica stream path: seconds to stall before
+    yielding the next streamed item.  ``{"after": N, "stall_s": X}``
+    stalls exactly the Nth item this process yields (one-shot,
+    deterministic) — long enough X trips the ingress stream-idle
+    timeout mid-stream, after real tokens have already been sent."""
+    fault = spec().stall_stream
+    if not fault:
+        return 0.0
+    after = int(fault.get("after", 1)) if isinstance(fault, dict) else 1
+    n = _counters.get("stall_stream", 0) + 1
+    _counters["stall_stream"] = n
+    if n == after:
+        return float(fault.get("stall_s", 5.0)) \
+            if isinstance(fault, dict) else 5.0
+    return 0.0
 
 
 # --------------------------------------------------------------- observers

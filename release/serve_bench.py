@@ -14,6 +14,15 @@ tiny config on CPU so the harness always emits parseable JSON.
 Emits JSON lines:
   {"metric": "serve_gpt2_tokens_per_sec", "value": ..., "p50_ms": ...,
    "p99_ms": ..., "vs_baseline": null}
+
+A second phase benchmarks the STREAMING path (paged KV-cache continuous
+batching through ``handle.remote_stream``): per-token timestamps give
+p50 time-to-first-token and mean inter-token latency at 1, 4, and 16
+concurrent sessions against one replica — the scaling curve shows
+iteration-level batching absorbing concurrency (TTFT grows far slower
+than linearly).  One JSON line per session count:
+  {"metric": "serve_stream_...", "sessions": N, "ttft_p50_ms": ...,
+   "inter_token_mean_ms": ..., "tokens_per_sec": ...}
 """
 
 from __future__ import annotations
@@ -113,6 +122,87 @@ class GPTGenerator:
         return await self._batched(prompt)
 
 
+def _stream_session(handle, payload):
+    """Consume one streamed generation, timestamping every token as its
+    ref resolves.  Runs in a driver thread (stream_next blocks off-loop)."""
+    import ray_tpu
+    t0 = time.perf_counter()
+    stamps = []
+    for ref in handle.remote_stream(payload):
+        ray_tpu.get(ref, timeout=600)
+        stamps.append(time.perf_counter())
+    return t0, stamps
+
+
+def run_streaming_bench(on_tpu: bool) -> None:
+    """Paged-KV continuous-batching streaming: p50 TTFT and inter-token
+    latency at 1/4/16 concurrent sessions against ONE replica."""
+    import concurrent.futures
+
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.serve.engine import EngineConfig, LLMServer
+
+    if on_tpu:
+        mc = GPTConfig.gpt2_small()
+        mc = type(mc)(**{**mc.__dict__, "max_seq_len": 128})
+    else:
+        mc = GPTConfig(vocab_size=97, max_seq_len=96, num_layers=2,
+                       num_heads=4, embed_dim=32, dtype=jnp.float32,
+                       attention="dense", remat=False)
+    gen_tokens = 24
+    ecfg = EngineConfig(model="gpt", model_config=mc, page_size=8,
+                        num_pages=128, max_batch=16, max_prompt_len=32,
+                        max_new_tokens=gen_tokens)
+    renv = None
+    if on_tpu:
+        renv = {"env_vars": {
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "axon"),
+            "PALLAS_AXON_POOL_IPS":
+                os.environ.get("PALLAS_AXON_POOL_IPS", ""),
+        }}
+    dep = serve.deployment(
+        name="llm_stream", max_concurrent_queries=32,
+        ray_actor_options={"runtime_env": renv} if renv else {},
+    )(LLMServer)
+    handle = serve.run(dep.bind(ecfg))
+    payload = {"tokens": list(range(1, 17)), "max_new_tokens": gen_tokens}
+    _stream_session(handle, payload)   # warmup: compiles prefill + decode
+
+    metric = ("serve_stream" if on_tpu else "serve_stream_cpu_smoke")
+    for sessions in (1, 4, 16):
+        with concurrent.futures.ThreadPoolExecutor(sessions) as pool:
+            t_wall = time.perf_counter()
+            futs = [pool.submit(_stream_session, handle, payload)
+                    for _ in range(sessions)]
+            outs = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t_wall
+        ttfts, gaps, n_tokens = [], [], 0
+        for t0, stamps in outs:
+            assert len(stamps) == gen_tokens, len(stamps)
+            ttfts.append(stamps[0] - t0)
+            gaps.extend(b - a for a, b in zip(stamps, stamps[1:]))
+            n_tokens += len(stamps)
+        # One metric name per session count so the release harness
+        # (run_release_suite.py keys records by "metric") keeps the whole
+        # scaling curve; "value" is tokens/s, the scaling signal.
+        print(json.dumps({
+            "metric": f"{metric}_{sessions}_sessions",
+            "value": round(n_tokens / wall, 2),
+            "unit": "tokens/s",
+            "sessions": sessions,
+            "ttft_p50_ms": round(
+                statistics.median(sorted(ttfts)) * 1000, 1),
+            "inter_token_mean_ms": round(
+                statistics.mean(gaps) * 1000, 2) if gaps else None,
+            "gen_tokens": gen_tokens,
+            "vs_baseline": None,
+        }), flush=True)
+
+
 def main() -> None:
     on_tpu = _probe_tpu() and os.environ.get("RT_SERVE_BENCH_CPU") != "1"
     n_requests = int(os.environ.get("RT_SERVE_BENCH_REQUESTS",
@@ -173,6 +263,8 @@ def main() -> None:
             "vs_baseline": None,
         }
         print(json.dumps(result), flush=True)
+
+        run_streaming_bench(on_tpu)
     finally:
         try:
             serve.shutdown()

@@ -13,13 +13,16 @@
 #            partition-chaos - control-plane partition faults only
 #                         (GCS connection loss, reconnect grace, head
 #                         restart; -m "chaos and partition_chaos")
+#            serve-chaos - serve ingress faults only (connection
+#                         storms, slow clients, stalled streams;
+#                         -m "chaos and serve_chaos")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
 PROFILE="all"
 case "${1:-}" in
-    all|data-chaos|partition-chaos)
+    all|data-chaos|partition-chaos|serve-chaos)
         PROFILE="$1"
         shift
         ;;
@@ -29,6 +32,8 @@ if [ "$PROFILE" = "data-chaos" ]; then
     MARKER="chaos and data_chaos"
 elif [ "$PROFILE" = "partition-chaos" ]; then
     MARKER="chaos and partition_chaos"
+elif [ "$PROFILE" = "serve-chaos" ]; then
+    MARKER="chaos and serve_chaos"
 fi
 
 RUNS="${CHAOS_RUNS:-3}"
