@@ -32,6 +32,8 @@ import collections
 import functools
 import hashlib
 import logging
+import os
+import sys
 import threading
 import time
 import traceback
@@ -40,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private import wire
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, task_id_generator
 from ray_tpu._private.object_ref import ObjectRef
@@ -57,6 +60,25 @@ from ray_tpu._private.config import config as _rt_config
 def INLINE_MAX() -> int:
     # objects at or below this ride inline in the owner (reference: 100KB)
     return _rt_config().inline_max_bytes
+
+
+class _NotInline(Exception):
+    """Control-flow signal: an arg entry needs the async resolve path."""
+
+
+_tracing = None
+
+
+def _tracing_mod():
+    """ray_tpu.util.tracing, imported once on first use: a module-level
+    import would be circular (ray_tpu.util -> placement_group -> worker ->
+    core_worker), and the per-call ``from ... import`` in the submit hot
+    path cost ~5us/call in import machinery."""
+    global _tracing
+    if _tracing is None:
+        from ray_tpu.util import tracing
+        _tracing = tracing
+    return _tracing
 
 
 def DEFAULT_MAX_RETRIES() -> int:
@@ -86,48 +108,87 @@ class ExecChannel:
         import queue
         self._loop = loop
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._staged: list = []
         t = threading.Thread(target=self._main, daemon=True, name="rt-exec")
         self._threads = [t]          # same shape as ThreadPoolExecutor's
         t.start()
 
     def _main(self) -> None:
         while True:
-            item = self._q.get()
-            if item is None:
+            batch = self._q.get()
+            if batch is None:
                 return
-            fut, fn = item
-            if fut.cancelled():
-                # Cancelled while queued (ray_tpu.cancel on a parked actor
-                # call): the body must not run.  Reading the flag off-loop
-                # is GIL-safe; a cancel landing after this check races the
-                # body exactly as ThreadPoolExecutor's did.
-                continue
-            try:
-                ok, res = True, fn()
-            except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
-                ok, res = False, e
-            try:
-                self._loop.call_soon_threadsafe(self._finish, fut, ok, res)
-            except RuntimeError:
-                return               # loop closed mid-shutdown
+            # Results coalesce too: one call_soon_threadsafe (one
+            # self-pipe write) delivers every finish from a burst of
+            # short bodies.  A flush every _FINISH_FLUSH_S bounds the
+            # extra latency a long body could add to earlier finishes.
+            done: list = []
+            deadline = time.monotonic() + self._FINISH_FLUSH_S
+            for fut, fn in batch:
+                if fut.cancelled():
+                    # Cancelled while queued (ray_tpu.cancel on a parked
+                    # actor call): the body must not run.  Reading the flag
+                    # off-loop is GIL-safe; a cancel landing after this
+                    # check races the body exactly as ThreadPoolExecutor's
+                    # did.
+                    continue
+                try:
+                    ok, res = True, fn()
+                except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
+                    ok, res = False, e
+                done.append((fut, ok, res))
+                if time.monotonic() >= deadline:
+                    if not self._flush_done(done):
+                        return       # loop closed mid-shutdown
+                    done = []
+                    deadline = time.monotonic() + self._FINISH_FLUSH_S
+            if not self._flush_done(done):
+                return
+
+    _FINISH_FLUSH_S = 0.001
+
+    def _flush_done(self, done: list) -> bool:
+        if not done:
+            return True
+        try:
+            self._loop.call_soon_threadsafe(self._finish_batch, done)
+            return True
+        except RuntimeError:
+            return False             # loop closed mid-shutdown
 
     @staticmethod
-    def _finish(fut: asyncio.Future, ok: bool, res) -> None:
-        if fut.cancelled():
-            return
-        if ok:
-            fut.set_result(res)
-        else:
-            fut.set_exception(res)
+    def _finish_batch(done: list) -> None:
+        for fut, ok, res in done:
+            if fut.cancelled():
+                continue
+            if ok:
+                fut.set_result(res)
+            else:
+                fut.set_exception(res)
 
     def run(self, fn) -> asyncio.Future:
         """Schedule fn on the exec thread; await the returned future.
-        Loop-thread callers only (the future belongs to the loop)."""
+        Loop-thread callers only (the future belongs to the loop).
+
+        Hand-off is coalesced per loop tick: same-tick submissions (a
+        batched actor-call burst) stage on a list and reach the queue as
+        ONE put — one lock/wakeup per burst instead of per call, which
+        the n:n fan-in profile showed as a top-3 loop cost.  Results
+        still complete per item, so a long body doesn't hold earlier
+        finishes hostage."""
         fut = self._loop.create_future()
-        self._q.put((fut, fn))
+        self._staged.append((fut, fn))
+        if len(self._staged) == 1:
+            self._loop.call_soon(self._flush_staged)
         return fut
 
+    def _flush_staged(self) -> None:
+        batch, self._staged = self._staged, []
+        if batch:
+            self._q.put(batch)
+
     def shutdown(self, wait: bool = False) -> None:
+        self._flush_staged()
         self._q.put(None)
         if wait:
             self._threads[0].join(timeout=5)
@@ -168,6 +229,7 @@ class CoreWorker:
         # TaskEventBuffer, task_event_buffer.h).
         self._task_events: list = []
         self._event_flusher_started = False
+        self._pid = os.getpid()
         # task_id hex -> cancellation state (reference task_manager's
         # pending-task map feeding CancelTask); _cancel_refs maps the
         # first return-object id back to its task, popped together with
@@ -204,6 +266,11 @@ class CoreWorker:
         self._submit_queue: list = []
         self._submit_lock = threading.Lock()
         self._submit_scheduled = False
+        # Zero-ref frees coalesce the same way: a burst of ObjectRef
+        # __del__s (a drained get loop) costs one loop wakeup, not one
+        # call_soon_threadsafe per object.  Guarded by _ref_lock.
+        self._free_queue: list = []
+        self._free_scheduled = False
 
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._loop_main,
@@ -346,7 +413,19 @@ class CoreWorker:
             except Exception:
                 pass
 
+    def _fast_dispatch(self, conn, rid: int, msg) -> bool:
+        """Per-connection fast_handler: give the task executor (when this
+        process hosts one) a chance to serve an actor call without the
+        per-request asyncio task.  task_executor is resolved per call —
+        it is attached after the server starts accepting."""
+        ex = self.task_executor
+        if ex is None:
+            return False
+        return ex.fast_actor_call(conn, rid, msg)
+
     def _make_handler(self, conn: RpcConnection):
+        conn.fast_handler = functools.partial(self._fast_dispatch, conn)
+
         async def handle(msg: dict):
             mtype = msg["type"]
             if mtype == "get_object":
@@ -382,9 +461,10 @@ class CoreWorker:
     def record_task_event(self, event: dict):
         """Buffer a task profile event; flushed to the GCS once a second
         (feeds the state API and `ray_tpu.timeline`)."""
-        import os as _os
-        event.setdefault("pid", _os.getpid())
-        event.setdefault("node_id", self.node_id_hex)
+        if "pid" not in event:
+            event["pid"] = self._pid
+        if "node_id" not in event:
+            event["node_id"] = self.node_id_hex
         self._task_events.append(event)
         if not self._event_flusher_started:
             self._event_flusher_started = True
@@ -428,6 +508,11 @@ class CoreWorker:
         kind, data = self.memory_store[oid]
         if kind == "val":
             return {"status": "inline", "data": data}
+        if kind == "pval" or kind == "ndval":
+            # Raw fast-lane return (zero-pickle): the value (or the
+            # ndarray triple) itself rides the reply, no serialized
+            # envelope to unwrap.
+            return {"status": kind, "data": data}
         if kind == "err":
             return {"status": "error", "data": data}
         # "plasma" and "cval" (a client-mode byte cache layered over a
@@ -469,13 +554,12 @@ class CoreWorker:
         st = self._streams.get(msg["task_id"])
         oid_hex, kind, data = msg["entry"]
         if st is None or st["cancelled"]:
-            if kind != "inline":
+            if kind not in ("inline", "pval", "ndval"):
                 asyncio.ensure_future(self.gcs.notify(
                     {"type": "object_freed", "object_id": oid_hex}))
             return {"ok": False, "cancelled": True}
         self.owned.add(oid_hex)
-        self._store_local(oid_hex, "val" if kind == "inline" else "plasma",
-                          data)
+        self._store_return_entry(oid_hex, kind, data)
         ref = ObjectRef(ObjectID.from_hex(oid_hex), self.address)
         st["queue"].append(ref)
         st["event"].set()
@@ -593,12 +677,25 @@ class CoreWorker:
             if h in self._borrowing:
                 self._borrowing.discard(h)
                 deregister = True
+            self._free_queue.append(oid)
+            wake = not self._free_scheduled
+            self._free_scheduled = True
         if self.loop.is_closed():
             return
         if deregister:
             asyncio.run_coroutine_threadsafe(
                 self._send_borrow(h, owner_address, add=False), self.loop)
-        self.loop.call_soon_threadsafe(self._free_object, oid)
+        if wake:
+            self.loop.call_soon_threadsafe(self._flush_frees)
+
+    def _flush_frees(self) -> None:
+        """Loop-side: free every object whose last local ref dropped since
+        the previous tick."""
+        with self._ref_lock:
+            batch, self._free_queue = self._free_queue, []
+            self._free_scheduled = False
+        for oid in batch:
+            self._free_object(oid)
 
     async def _send_borrow(self, oid_hex: str, owner: str, add: bool):
         try:
@@ -791,6 +888,10 @@ class CoreWorker:
 
     def _materialize(self, data):
         kind, payload = data
+        if kind == "pval":
+            return payload       # raw primitive: the value IS the payload
+        if kind == "ndval":
+            return self._rebuild_ndarray(("nd",) + tuple(payload))
         if kind == "err":
             e, tb = cloudpickle.loads(payload)
             if isinstance(e, rex.RayTpuError):
@@ -801,11 +902,13 @@ class CoreWorker:
 
     async def _resolve_bytes(self, oid: ObjectID, owner: str,
                              deadline: Optional[float] = None):
-        """Resolve an object id to ('val'|'err', bytes) from anywhere."""
+        """Resolve an object id to ('val'|'err', bytes) — or ('pval',
+        raw primitive) — from anywhere."""
         h = oid.hex()
         while True:
             entry = self.memory_store.get(h)
-            if entry is not None and entry[0] in ("val", "err"):
+            if entry is not None and entry[0] in ("val", "err", "pval",
+                                                  "ndval"):
                 return entry
             if entry is not None and entry[0] == "cval":
                 return ("val", entry[1])   # client-mode byte cache
@@ -853,6 +956,8 @@ class CoreWorker:
                     owner_reachable = True
                     if reply["status"] == "inline":
                         return ("val", reply["data"])
+                    if reply["status"] in ("pval", "ndval"):
+                        return (reply["status"], reply["data"])
                     if reply["status"] == "error":
                         return ("err", reply["data"])
                     if reply["status"] == "plasma":
@@ -1156,6 +1261,11 @@ class CoreWorker:
         reference_count.h:61).  Large pass-by-value args are promoted to
         plasma objects; their temp ObjectRefs join the pin list so they are
         freed when the submission drops them (round-1 leaked these forever)."""
+        if not args and not kwargs:
+            # Zero-arg calls skip the pin scan and the pickled-ref
+            # observer entirely (the context manager alone is ~5us, on a
+            # path measured in tens of us).
+            return [], {}, []
         pinned = [a for a in args if isinstance(a, ObjectRef)]
         pinned += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
         # Refs nested inside containers are collected during pickling and
@@ -1167,13 +1277,39 @@ class CoreWorker:
                           for k, v in kwargs.items()}
         return out_args, out_kwargs, pinned
 
+    # Arg entry kinds on the wire:
+    #   ("p", value)                     raw primitive, no serialization at
+    #                                    all — rides the frame codec as-is
+    #   ("nd", dtype, shape, bytes)      small C-contiguous ndarray
+    #   ("v", bytes)                     RTP1-serialized inline value
+    #   ("ref", hex, owner)              pass-by-reference
+    # The raw kinds exist because the v2 frame codec (marshal / tagged)
+    # carries primitives natively: pickling them into a ("v", ...) envelope
+    # just to unpickle on the executor was the double-serialization the
+    # n:n profile billed ~22µs/call for.
+    _RAW_TYPES = frozenset((type(None), bool, int, float))
+
     def _serialize_one(self, value, pinned: list):
-        if isinstance(value, ObjectRef):
+        t = type(value)
+        if t in self._RAW_TYPES:
+            return ("p", value)
+        if t is str or t is bytes:
+            if len(value) <= INLINE_MAX():
+                return ("p", value)
+        elif isinstance(value, ObjectRef):
             entry = self.memory_store.get(value.hex())
-            if entry is not None and entry[0] == "val" and \
-                    len(entry[1]) <= INLINE_MAX():
-                return ("v", entry[1])
+            if entry is not None:
+                if entry[0] == "pval":
+                    return ("p", entry[1])
+                if entry[0] == "ndval":
+                    return ("nd",) + tuple(entry[1])
+                if entry[0] == "val" and len(entry[1]) <= INLINE_MAX():
+                    return ("v", entry[1])
             return ("ref", value.hex(), value.owner_address)
+        else:
+            nd = self._serialize_ndarray(value, t)
+            if nd is not None:
+                return nd
         ser = self.ser.serialize(value)
         if ser.total_size <= INLINE_MAX() or self.plasma is None:
             return ("v", ser.to_bytes())
@@ -1185,6 +1321,30 @@ class CoreWorker:
         pinned.append(ObjectRef(oid, self.address))
         return ("ref", oid.hex(), self.address)
 
+    @staticmethod
+    def _serialize_ndarray(value, t):
+        """("nd", dtype, shape, bytes) for a small plain ndarray, else
+        None.  Exact np.ndarray only (subclasses may carry reducers), no
+        object dtype, C-contiguous, and under the inline ceiling so the
+        plasma-promotion path keeps large arrays."""
+        np = sys.modules.get("numpy")
+        if np is None or t is not np.ndarray:
+            return None
+        if (value.nbytes > INLINE_MAX() or value.dtype.hasobject
+                or not value.flags.c_contiguous):
+            return None
+        return ("nd", value.dtype.str, value.shape, value.tobytes())
+
+    @staticmethod
+    def _rebuild_ndarray(entry):
+        import numpy as np
+        _, dtype, shape, data = entry
+        # bytearray copy -> the rebuilt array is writable (matching what
+        # the pickle lane hands user code) and independent of the frame
+        # buffer the bytes may be a view over.
+        return np.frombuffer(bytearray(data), dtype=dtype).reshape(
+            tuple(shape))
+
     def _run_on_loop_sync(self, coro):
         if threading.get_ident() == self._loop_thread.ident:
             return asyncio.ensure_future(coro, loop=self.loop)
@@ -1195,20 +1355,33 @@ class CoreWorker:
         without the async machinery (no gather, no wait_for task/timer) —
         the common case for small actor calls, and a measurable win on the
         calls/s hot path.  Returns None when an async fetch is needed."""
-        if any(e[0] != "v" for e in args_entries) or \
-                any(e[0] != "v" for e in kwargs_entries.values()):
+        try:
+            args = [self._resolve_inline(e) for e in args_entries]
+            kwargs = {k: self._resolve_inline(e)
+                      for k, e in kwargs_entries.items()}
+        except _NotInline:
             return None
-        args = [self.ser.deserialize(memoryview(e[1]))
-                for e in args_entries]
-        kwargs = {k: self.ser.deserialize(memoryview(e[1]))
-                  for k, e in kwargs_entries.items()}
         return args, kwargs
+
+    def _resolve_inline(self, entry):
+        kind = entry[0]
+        if kind == "p":
+            return entry[1]
+        if kind == "v":
+            return self.ser.deserialize(memoryview(entry[1]))
+        if kind == "nd":
+            return self._rebuild_ndarray(entry)
+        raise _NotInline
 
     async def resolve_args(self, args_entries, kwargs_entries):
         async def one(entry):
             kind = entry[0]
+            if kind == "p":
+                return entry[1]
             if kind == "v":
                 return self.ser.deserialize(memoryview(entry[1]))
+            if kind == "nd":
+                return self._rebuild_ndarray(entry)
             _, oid_hex, owner = entry
             data = await self._resolve_bytes(ObjectID.from_hex(oid_hex), owner)
             return self._materialize(data)
@@ -1252,7 +1425,7 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": self.address,
         }
-        from ray_tpu.util import tracing
+        tracing = _tracing_mod()
         if tracing.enabled():
             # Propagate the caller's span so the executor's task span
             # joins this trace (reference tracing_helper.py:53).
@@ -1395,6 +1568,10 @@ class CoreWorker:
         attempts = max_retries + 1
         last_err: Optional[BaseException] = None
         attempt = 0
+        # Encode-once: the push frame is serialized here and the encoded
+        # body spliced verbatim into every (re)send across the whole
+        # retry chain — the spec is never re-encoded per attempt.
+        push_msg = wire.PreEncoded({"type": "push_task", "spec": spec})
         # System-level retriable failures (arg-resolution timeout releasing
         # a lease under a lost-object deadlock) get their OWN budget: the
         # function body never ran, so even max_retries=0 tasks are safe to
@@ -1405,7 +1582,8 @@ class CoreWorker:
                 self._store_cancelled(spec, return_ids)
                 return
             try:
-                reply = await self._submit_once(spec, resources, scheduling)
+                reply = await self._submit_once(spec, resources, scheduling,
+                                                push_msg)
             except ConnectionLost:
                 if cancel_st.get("cancelled"):
                     # force-cancel killed the worker: that's the requested
@@ -1546,7 +1724,8 @@ class CoreWorker:
             req.add_done_callback(_return_late_grant)
             raise
 
-    async def _submit_once(self, spec, resources, scheduling) -> dict:
+    async def _submit_once(self, spec, resources, scheduling,
+                           push_msg=None) -> dict:
         logger.debug("task %s %s: leasing", spec["task_id"][:8],
                      spec["name"])
         raylet = self.raylet
@@ -1643,7 +1822,8 @@ class CoreWorker:
             logger.debug("task %s: pushing to %s", spec["task_id"][:8],
                          grant["worker_address"])
             reply = await worker_conn.request(
-                {"type": "push_task", "spec": spec}, timeout=None)
+                push_msg if push_msg is not None
+                else {"type": "push_task", "spec": spec}, timeout=None)
             logger.debug("task %s: reply ok=%s", spec["task_id"][:8],
                          reply.get("ok"))
             # Never reuse a worker a cancel was aimed at — even if the
@@ -1692,7 +1872,7 @@ class CoreWorker:
             # will ever release otherwise (same fan-out _free_object
             # uses; the GCS forwards the free to every holder raylet).
             for oid_hex, kind, _data in entries[len(return_ids):]:
-                if kind != "inline":
+                if kind not in ("inline", "pval", "ndval"):
                     asyncio.ensure_future(
                         self.gcs.notify({"type": "object_freed",
                                          "object_id": oid_hex}),
@@ -1700,17 +1880,19 @@ class CoreWorker:
             entries = entries[:len(return_ids)]
         for oid_hex, kind, data in entries[len(return_ids):]:
             self.owned.add(oid_hex)
-            if kind == "inline":
-                self._store_local(oid_hex, "val", data)
-            else:
-                self._store_local(oid_hex, "plasma", None)
+            self._store_return_entry(oid_hex, kind, data)
         for (oid_hex, kind, data), oid in zip(entries, return_ids):
             if oid_hex not in self.owned:
                 continue  # freed while the task (or a reconstruction) ran
-            if kind == "inline":
-                self._store_local(oid_hex, "val", data)
-            else:  # plasma, located on executor's node (directory has it)
-                self._store_local(oid_hex, "plasma", None)
+            self._store_return_entry(oid_hex, kind, data)
+
+    def _store_return_entry(self, oid_hex: str, kind: str, data):
+        if kind == "inline":
+            self._store_local(oid_hex, "val", data)
+        elif kind == "pval" or kind == "ndval":  # raw fast-lane value
+            self._store_local(oid_hex, kind, data)
+        else:  # plasma, located on executor's node (directory has it)
+            self._store_local(oid_hex, "plasma", None)
 
     # ------------------------------------------------------------ actors
 
@@ -1810,7 +1992,7 @@ class CoreWorker:
         }
         if concurrency_group is not None:
             call["concurrency_group"] = concurrency_group
-        from ray_tpu.util import tracing
+        tracing = _tracing_mod()
         if tracing.enabled():
             call["trace"] = {"ctx": tracing.current_context()}
         cst = {"cancelled": False, "actor": actor_id_hex}
@@ -1876,10 +2058,13 @@ class CoreWorker:
                     return_ids)
                 self._finish_actor_entry(st, actor_id_hex, call, return_ids)
                 continue
-            sent = dict(call)
-            sent["seq"] = st["seq"]
+            # seq is assigned in place: the call dict is built per
+            # submission and owned by this submit path, so the copy the
+            # old code made per send was pure overhead.  A fallback
+            # resend overwrites it with a fresh seq.
+            call["seq"] = st["seq"]
             st["seq"] += 1
-            msgs.append(sent)
+            msgs.append(call)
             metas.append((call, return_ids, pinned))
         if not msgs:
             return
@@ -1983,14 +2168,13 @@ class CoreWorker:
                         {"name": call["method"],
                          "task_id": call["call_id"]}, return_ids)
                     return
-                sent = dict(call)
-                sent["seq"] = st["seq"]
+                call["seq"] = st["seq"]
                 st["seq"] += 1
                 logger.debug("actor call %s.%s seq=%s: sending",
-                             actor_id_hex[:8], call["method"], sent["seq"])
-                reply = await conn.request(sent, timeout=None)
+                             actor_id_hex[:8], call["method"], call["seq"])
+                reply = await conn.request(call, timeout=None)
                 logger.debug("actor call %s.%s seq=%s: reply ok=%s",
-                             actor_id_hex[:8], call["method"], sent["seq"],
+                             actor_id_hex[:8], call["method"], call["seq"],
                              reply.get("ok"))
                 if reply.get("retriable") and sys_attempt < 10:
                     await asyncio.sleep(min(2.0 * (sys_attempt + 1), 10.0))
@@ -2141,9 +2325,34 @@ class CoreWorker:
 
     # -- executor-side helpers (used by worker_main's TaskExecutor) --
 
-    async def store_return_value_async(self, oid: ObjectID, ser
+    def pack_return_sync(self, h: str, value):
+        """Pack one task return without awaiting: (entry, None) for the
+        pval / ndval / inline kinds, or (None, ser) when the value is
+        plasma-bound and the caller must take the async path.  Split out
+        of store_return_value_async so the zero-task actor-call reply
+        path (TaskExecutor.fast_actor_call) can pack common returns from
+        a plain done-callback.  Takes the object id's hex form directly:
+        the fast path derives it by string surgery on the call id rather
+        than materialising TaskID/ObjectID pairs per call."""
+        t = type(value)
+        if t in self._RAW_TYPES or (
+                (t is str or t is bytes) and len(value) <= INLINE_MAX()):
+            return (h, "pval", value), None
+        nd = self._serialize_ndarray(value, t)
+        if nd is not None:
+            return (h, "ndval", nd[1:]), None
+        ser = self.ser.serialize(value)
+        if ser.total_size <= INLINE_MAX() or self.plasma is None:
+            return (h, "inline", ser.to_bytes()), None
+        return None, ser
+
+    async def store_return_value_async(self, oid: ObjectID, value
                                        ) -> Tuple[str, str, Any]:
-        """Store one task return; returns the reply entry (hex, kind, data).
+        """Serialize + store one task return; returns the reply entry
+        (hex, kind, data).  kind "pval" carries a raw primitive straight
+        into the reply frame (zero-pickle fast lane: the v2 codec encodes
+        it natively, and the owner stores the value itself — no RTP1
+        envelope on either side).
 
         The GCS location registration is AWAITED before the entry (and thus
         the task reply) is released: a fire-and-forget add lets the owner
@@ -2151,8 +2360,9 @@ class CoreWorker:
         immediate raylet pull (wait fetch_local, remote gets) finds 'no
         locations' for an object that exists."""
         h = oid.hex()
-        if ser.total_size <= INLINE_MAX() or self.plasma is None:
-            return (h, "inline", ser.to_bytes())
+        entry, ser = self.pack_return_sync(h, value)
+        if entry is not None:
+            return entry
         await self._plasma_put(oid, ser)
         await self.gcs.request({
             "type": "object_location_add", "object_id": h,

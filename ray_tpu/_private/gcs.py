@@ -477,7 +477,8 @@ class GcsServer:
                     break
         req = {"type": "profile_worker", "pid": pid,
                "duration": msg.get("duration", 5.0),
-               "interval": msg.get("interval", 0.01)}
+               "interval": msg.get("interval", 0.01),
+               "threads": msg.get("threads", "exec")}
         req_timeout = float(msg.get("duration", 5.0)) + 40.0
         if target is None:
             # The stats view is periodic and a freshly spawned worker

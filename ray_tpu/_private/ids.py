@@ -122,11 +122,9 @@ class _TaskIDGenerator:
         with self._lock:
             self._counter += 1
             c = self._counter
-        raw = self._base + c.to_bytes(4, "big")
-        # Zero the low two bytes used by ObjectID.for_task_return's index slot:
-        # pack the counter into bytes 12..13 instead.
-        raw = raw[:10] + c.to_bytes(4, "big")[0:4] + b"\x00\x00"
-        return TaskID(raw)
+        # Low two bytes stay zero: ObjectID.for_task_return owns that index
+        # slot; the counter rides bytes 10..13.
+        return TaskID(self._base[:10] + c.to_bytes(4, "big") + b"\x00\x00")
 
 
 task_id_generator = _TaskIDGenerator()

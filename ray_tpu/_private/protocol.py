@@ -22,6 +22,8 @@ import struct
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ray_tpu._private import wire
+
 logger = logging.getLogger(__name__)
 
 _HEADER = struct.Struct("<I")
@@ -31,6 +33,12 @@ _REQUEST = 0
 _REPLY = 1
 _NOTIFY = 2
 _BATCH = 3   # payload: [(kind, rid, msg), ...] — transport-level coalescing
+
+# v2 outbox flush bounds: cut a mixed batch frame once this many body
+# bytes have accumulated, and flush the outbox early (without waiting for
+# the call_soon tick) once this many messages are queued.
+_V2_BATCH_CUT_BYTES = 256 * 1024
+_OUTBOX_FLUSH_ITEMS = 512
 
 
 class ConnectionLost(Exception):
@@ -110,6 +118,12 @@ class RpcConnection:
         self.reader = reader
         self.writer = writer
         self.handler = handler
+        # Optional synchronous request dispatcher tried BEFORE spawning a
+        # per-request asyncio task: fast_handler(rid, msg) -> bool.  True
+        # means the request was fully taken over (the callee replies later
+        # via reply_soon); False routes it down the normal handler task.
+        # The actor hot path uses this to skip the Task machinery.
+        self.fast_handler: Optional[Callable[[int, Any], bool]] = None
         self.name = name
         self._req_counter = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
@@ -124,9 +138,24 @@ class RpcConnection:
         # instead of a frame each.  Bulk payloads (chunk transfer) bypass
         # it via _send_frame so megabytes never sit in a Python list.
         self._outbox: list = []
+        # Wire negotiation state: we always ACCEPT both framings; what we
+        # SEND upgrades to v2 only after the peer's hello proves it can
+        # read it (and shares our marshal format — see wire.py).  Until
+        # then everything rides legacy pickle frames, so mixed-version
+        # links (including mid-redial ReconnectingConnection heals)
+        # degrade instead of desyncing.
+        self._wire_v2 = wire.enabled()
+        self.peer_wire_version = 1
+        self._peer_fast = False
         _maybe_install_env_fault()
 
     def start(self):
+        # The hello is the first queued message; the first flush always
+        # runs before negotiation completes, so it rides a legacy frame
+        # any peer can read.  Old peers log one unknown-notify error and
+        # keep the connection.
+        if self._wire_v2:
+            self._send_soon(_NOTIFY, 0, wire.hello_message())
         self._serve_task = asyncio.get_running_loop().create_task(self._serve())
         self._maybe_schedule_partition()
         return self._serve_task
@@ -233,8 +262,14 @@ class RpcConnection:
         callbacks) — so replies are never held behind other calls'
         completion, only coalesced with already-completed ones."""
         self._outbox.append((kind, rid, msg))
-        if len(self._outbox) == 1:
+        n = len(self._outbox)
+        if n == 1:
             asyncio.get_running_loop().call_soon(self._flush_outbox)
+        elif n >= _OUTBOX_FLUSH_ITEMS:
+            # Size bound: a burst bigger than the batch budget flushes
+            # now; the already-scheduled call_soon then sees an empty
+            # outbox and no-ops.
+            self._flush_outbox()
 
     def _flush_outbox(self) -> None:
         ob = self._outbox
@@ -242,6 +277,9 @@ class RpcConnection:
             self._outbox = []
             return
         self._outbox = []
+        if self._wire_v2 and self.peer_wire_version >= 2 and self._peer_fast:
+            self._flush_outbox_v2(ob)
+            return
         try:
             if len(ob) == 1:
                 payload = pickle.dumps(ob[0], protocol=5)
@@ -257,14 +295,62 @@ class RpcConnection:
                 try:
                     self._write_frame_nowait(pickle.dumps(item, protocol=5))
                 except Exception as e:
-                    kind, rid, _msg = item
-                    if kind == _REQUEST:
-                        fut = self._pending.pop(rid, None)
-                        if fut is not None and not fut.done():
-                            fut.set_exception(e)
-                    else:
-                        logger.exception(
-                            "dropping unpicklable message on %s", self.name)
+                    self._fail_send(item, e)
+
+    def _flush_outbox_v2(self, ob: list) -> None:
+        """Binary-framed flush: one marshal call for a uniform batch, the
+        mixed per-item form (PreEncoded splices, big buffers, pickle
+        fallbacks) otherwise, cut into frames at _V2_BATCH_CUT_BYTES."""
+        if len(ob) == 1:
+            kind, rid, msg = ob[0]
+            try:
+                payload = wire.encode_frame(kind, rid, msg)
+            except Exception as e:
+                self._fail_send(ob[0], e)
+                return
+            self._write_frame_nowait(payload)
+            return
+        if not any(wire.has_big_buffer(m) or m.__class__ is wire.PreEncoded
+                   for _k, _r, m in ob):
+            payload = wire.encode_batch_frame_fast(ob)
+            if payload is not None:
+                self._write_frame_nowait(payload)
+                return
+        parts: list = []
+        total = 0
+        for item in ob:
+            kind, rid, msg = item
+            try:
+                part = wire.encode_batch_item(kind, rid, msg)
+            except Exception as e:
+                self._fail_send(item, e)
+                continue
+            parts.append(part)
+            total += len(part)
+            if total >= _V2_BATCH_CUT_BYTES:
+                self._write_frame_nowait(wire.encode_batch_frame(parts))
+                parts, total = [], 0
+        if parts:
+            self._write_frame_nowait(wire.encode_batch_frame(parts))
+
+    def _fail_send(self, item, e: Exception) -> None:
+        # A message that cannot be encoded at all is dropped; a dropped
+        # REQUEST must fail its caller's pending future (it would
+        # otherwise await forever on a live connection).
+        kind, rid, _msg = item
+        if kind == _REQUEST:
+            fut = self._pending.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+        else:
+            logger.error(
+                "dropping unencodable message on %s: %r", self.name, e)
+
+    def reply_soon(self, rid: int, result, ok: bool = True) -> None:
+        """Queue the reply for a request taken over by fast_handler; rides
+        the outbox exactly like _handle's replies (same coalescing, same
+        FIFO order with them)."""
+        self._send_soon(_REPLY, rid, (ok, result))
 
     def request_batch(self, msgs) -> "list[asyncio.Future]":
         """Register N requests and queue them on the outbox; returns their
@@ -306,18 +392,39 @@ class RpcConnection:
             self._pending.pop(rid, None)
 
     async def notify(self, msg: dict):
-        """Fire-and-forget one-way message."""
+        """Fire-and-forget one-way message.  Rides the outbox so
+        same-tick notifies (stream acks, blocked/unblocked transitions)
+        coalesce with queued requests and replies into one frame, in
+        FIFO order with them."""
         if self._closed:
             raise ConnectionLost(f"connection {self.name} is closed")
-        await self._send_frame(pickle.dumps((_NOTIFY, 0, msg), protocol=5))
+        self._send_soon(_NOTIFY, 0, msg)
+        await self.maybe_drain()
+
+    def _apply_hello(self, msg: dict) -> None:
+        try:
+            v = int(msg.get("v") or 1)
+        except (TypeError, ValueError):
+            v = 1
+        self.peer_wire_version = min(wire.WIRE_VERSION, v)
+        self._peer_fast = wire.peer_fast_ok(msg)
 
     async def _serve(self):
         try:
             while True:
                 frame = await self._read_frame()
-                kind, rid, msg = pickle.loads(frame)
+                # First payload byte routes the framing: v2 frames start
+                # with the wire MAGIC, legacy pickle streams with the
+                # 0x80 PROTO opcode.  Both are always accepted.
+                if frame and frame[0] == wire.MAGIC:
+                    kind, rid, msg = wire.decode_frame(frame)
+                else:
+                    kind, rid, msg = pickle.loads(frame)
                 if kind == _REQUEST:
-                    asyncio.get_running_loop().create_task(self._handle(rid, msg))
+                    fh = self.fast_handler
+                    if fh is None or not fh(rid, msg):
+                        asyncio.get_running_loop().create_task(
+                            self._handle(rid, msg))
                 elif kind == _REPLY:
                     fut = self._pending.pop(rid, None)
                     if fut is not None and not fut.done():
@@ -327,6 +434,10 @@ class RpcConnection:
                         else:
                             fut.set_exception(value)
                 elif kind == _NOTIFY:
+                    if msg.__class__ is dict and \
+                            msg.get("type") == wire.HELLO_TYPE:
+                        self._apply_hello(msg)
+                        continue
                     asyncio.get_running_loop().create_task(self._handle(None, msg))
                 elif kind == _BATCH:
                     self._dispatch_batch(msg)
@@ -359,8 +470,14 @@ class RpcConnection:
                     else:
                         fut.set_exception(value)
             elif kind == _REQUEST:
-                loop.create_task(self._handle(rid, msg))
+                fh = self.fast_handler
+                if fh is None or not fh(rid, msg):
+                    loop.create_task(self._handle(rid, msg))
             elif kind == _NOTIFY:
+                if msg.__class__ is dict and \
+                        msg.get("type") == wire.HELLO_TYPE:
+                    self._apply_hello(msg)
+                    continue
                 loop.create_task(self._handle(None, msg))
 
     async def _handle(self, rid: Optional[int], msg: dict):
@@ -542,6 +659,16 @@ class ReconnectingConnection:
     def connected(self) -> bool:
         conn = self._conn
         return conn is not None and not conn.closed
+
+    @property
+    def peer_wire_version(self) -> int:
+        """Wire version of the CURRENT link.  Every redial builds a fresh
+        RpcConnection that renegotiates from scratch, so a heal onto an
+        older (or newer) peer settles on whatever that link supports."""
+        conn = self._conn
+        if conn is None or conn.closed:
+            return 1
+        return conn.peer_wire_version
 
     def _live(self) -> RpcConnection:
         if self._closed:

@@ -701,7 +701,8 @@ class Raylet:
                 return await w.conn.request(
                     {"type": "profile",
                      "duration": msg.get("duration", 5.0),
-                     "interval": msg.get("interval", 0.01)},
+                     "interval": msg.get("interval", 0.01),
+                     "threads": msg.get("threads", "exec")},
                     timeout=float(msg.get("duration", 5.0)) + 30.0)
         return {"ok": False, "error": f"no live worker with pid {pid} on "
                                       f"node {self.node_id.hex()[:12]}"}

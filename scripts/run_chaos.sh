@@ -16,13 +16,16 @@
 #            serve-chaos - serve ingress faults only (connection
 #                         storms, slow clients, stalled streams;
 #                         -m "chaos and serve_chaos")
+#            wire-chaos - wire-format faults only (dropped/garbled
+#                         v2 frames through the binary framing;
+#                         -m "chaos and wire_chaos")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
 PROFILE="all"
 case "${1:-}" in
-    all|data-chaos|partition-chaos|serve-chaos)
+    all|data-chaos|partition-chaos|serve-chaos|wire-chaos)
         PROFILE="$1"
         shift
         ;;
@@ -34,6 +37,8 @@ elif [ "$PROFILE" = "partition-chaos" ]; then
     MARKER="chaos and partition_chaos"
 elif [ "$PROFILE" = "serve-chaos" ]; then
     MARKER="chaos and serve_chaos"
+elif [ "$PROFILE" = "wire-chaos" ]; then
+    MARKER="chaos and wire_chaos"
 fi
 
 RUNS="${CHAOS_RUNS:-3}"
