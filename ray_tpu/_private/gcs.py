@@ -437,7 +437,9 @@ class GcsServer:
     _FOLDED_COUNTERS = ("spilled_objects", "restored_objects",
                         "objects_corrupted", "pull_retries",
                         "spill_fsync_ms", "gcs_reconnects",
-                        "node_disconnects", "resync_objects_readvertised")
+                        "node_disconnects", "resync_objects_readvertised",
+                        "autotune_cache_hits", "autotune_cache_misses",
+                        "autotune_tune_ms")
 
     def dead_spill_totals(self) -> Dict[str, int]:
         """Aggregate spill/restore/integrity counters folded from dead

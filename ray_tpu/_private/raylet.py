@@ -477,6 +477,14 @@ class Raylet:
             "node_disconnects": self._node_disconnects,
             "resync_objects_readvertised": self._resync_objects_readvertised,
         }
+        try:
+            # Kernel-autotune counters (cache hits/misses, tune wall-clock)
+            # for THIS process; worker-process tuning reaches the dashboard
+            # via util.metrics aggregation instead.
+            from ray_tpu.autotune import metrics as _autotune_metrics
+            out.update(_autotune_metrics.stats())
+        except Exception:
+            pass
         if self._watchdog is not None:
             out.update(self._watchdog.record())
         return out

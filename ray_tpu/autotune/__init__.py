@@ -1,0 +1,29 @@
+"""Kernel autotune subsystem: block-config search, persistent cache,
+and measured kernel-variant dispatch.
+
+Three modules (see ARCHITECTURE.md "Kernel autotuning & dispatch"):
+
+* ``cache``    — JSON-lines persistent cache, keyed by (op, backend
+  fingerprint, canonical shape key); survives restarts, shared across
+  processes.
+* ``search``   — timing harness + pruned block sweeps per registered op
+  (flash block_q/block_k, splash fwd/dkv/dq blocks), interpret-aware so
+  the same code runs on CPU CI and for real on TPU.
+* ``dispatch`` — ``attention(q, k, v, ...)``: picks flash / ring /
+  dense / splash per shape from measured crossover records.
+
+Importing this package must stay cheap and jax-free: the raylet reads
+``metrics.stats()`` for node stats, and benches import the cache before
+deciding whether to touch a TPU.  ``search`` and ``dispatch`` import jax
+lazily inside their functions; they are NOT imported here — import them
+explicitly (``from ray_tpu.autotune import dispatch``).
+"""
+
+from ray_tpu.autotune import metrics  # noqa: F401  (jax-free)
+from ray_tpu.autotune.cache import (AutotuneCache, attention_key,  # noqa
+                                    backend_fingerprint, cache_path,
+                                    canon_dtype, get_cache, norm_batch)
+
+__all__ = ["AutotuneCache", "attention_key", "backend_fingerprint",
+           "cache_path", "canon_dtype", "get_cache", "norm_batch",
+           "metrics"]

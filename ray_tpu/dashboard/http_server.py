@@ -219,7 +219,9 @@ class DashboardHttpServer:
             for name in ("objects_corrupted", "pull_retries",
                          "spill_fsync_ms", "gcs_reconnects",
                          "node_disconnects",
-                         "resync_objects_readvertised"):
+                         "resync_objects_readvertised",
+                         "autotune_cache_hits", "autotune_cache_misses",
+                         "autotune_tune_ms"):
                 if name in st:
                     lag_records.append({
                         "name": name, "type": "counter",
@@ -235,9 +237,18 @@ class DashboardHttpServer:
         # raw records would emit duplicate series and drop histogram
         # buckets, and any per-endpoint renaming would give one metric two
         # series names depending on scrape point.
+        # Autotune counters flow through the user-metrics pipe (worker
+        # processes flush them like any Counter) but are SYSTEM series:
+        # split them out under the ray_tpu_ prefix so operators find
+        # cache hit rate and cold-tune cost next to the other health
+        # series, not namespaced as user metrics.
+        agg = self.gcs.aggregated_metrics()
+        autotune = [m for m in agg
+                    if str(m.get("name", "")).startswith("autotune_")]
+        user = [m for m in agg if m not in autotune]
         return "\n".join(lines) + "\n" + \
-            render_prometheus(lag_records, prefix="ray_tpu_") + \
-            render_prometheus(self.gcs.aggregated_metrics())
+            render_prometheus(lag_records + autotune, prefix="ray_tpu_") + \
+            render_prometheus(user)
 
 
 # Single-file live UI (reference: the dashboard/client React app, scaled to

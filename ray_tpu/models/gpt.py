@@ -22,6 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.parallel.sharding import LogicalAxisRules, with_logical_constraint
+from ray_tpu.util import jax_compat
+
+jax_compat.install()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,6 +195,25 @@ def _flash_profitable(S: int) -> bool:
         return False
 
 
+def _auto_attention_variant(B: int, S: int, cfg) -> str:
+    """attention="auto" resolution: a measured crossover record from the
+    autotune cache (ray_tpu.autotune) wins when one exists for this
+    shape/backend; a cold cache inherits the static _flash_profitable
+    heuristic unchanged (RT_AUTOTUNE_ON_MISS=inline tunes instead).
+    Only flash/dense are selectable here — ring requires an explicit
+    mesh topology commitment (cfg.attention="ring")."""
+    try:
+        from ray_tpu.autotune.dispatch import choose
+        v, rec = choose(B, S, cfg.num_heads,
+                        cfg.embed_dim // cfg.num_heads, cfg.dtype,
+                        causal=True, allowed=("flash", "dense"))
+        if rec is not None:
+            return v
+    except Exception:
+        pass
+    return "flash" if _flash_profitable(S) else "dense"
+
+
 def _dense_causal_attention(q, k, v):
     """[B,S,N,H] bf16 attention with causal mask; softmax in f32."""
     S = q.shape[1]
@@ -287,7 +309,7 @@ def gpt_hidden(params: Dict[str, Any], tokens: jax.Array,
     B, S = tokens.shape
     attention = cfg.attention
     if attention == "auto":
-        attention = "flash" if _flash_profitable(S) else "dense"
+        attention = _auto_attention_variant(B, S, cfg)
     if attention == "ring" and mesh is not None:
         from jax.sharding import PartitionSpec as P
         from ray_tpu.ops.ring_attention import ring_attention_sharded

@@ -214,11 +214,11 @@ def llama_hidden(params: Dict[str, Any], tokens: jax.Array,
     """tokens [B, S] int32 -> final hidden [B, S, D] after rms_norm (compute
     dtype) — the trunk without the LM head (see gpt_hidden)."""
     dt = cfg.dtype
-    S = tokens.shape[1]
+    B, S = tokens.shape
     attention = cfg.attention
     if attention == "auto":
-        from ray_tpu.models.gpt import _flash_profitable
-        attention = "flash" if _flash_profitable(S) else "dense"
+        from ray_tpu.models.gpt import _auto_attention_variant
+        attention = _auto_attention_variant(B, S, cfg)
     if attention == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 

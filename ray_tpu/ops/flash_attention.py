@@ -75,11 +75,14 @@ def _default_blocks(S: int, H: int, strict: bool = True) -> tuple:
     if strict and (bq < 8 or bk < 8):
         # strict=False (interpret mode) permits sub-tile blocks: the
         # interpreter has no Mosaic tiling constraint.
+        from ray_tpu.autotune.search import suggest_blocks
+        S_pad, sq, sk = suggest_blocks(S)
         raise ValueError(
             f"flash_attention: sequence length {S} only admits block sizes "
             f"({bq}, {bk}) < 8, which the TPU compiler rejects. Pad the "
-            f"sequence to a multiple of 8 (ideally 128) or pass explicit "
-            f"block_q/block_k that divide it.")
+            f"sequence to {S_pad} and use block_q={sq}, block_k={sk} "
+            f"(mask the tail), or pass explicit block_q/block_k >= 8 that "
+            f"divide {S}.")
     return bq, bk
 
 
@@ -408,6 +411,32 @@ def flash_attention(q, k, v, causal: bool = True,
     return out
 
 
+# Shape keys whose autotune-cache consultation already happened (and was
+# counted): repeat _resolve calls for the same shape skip the counters so
+# the hot path doesn't inflate hit counts per kernel invocation.
+_CACHE_CONSULTED: set = set()
+
+
+def _cached_blocks(B, S, N, H, dtype, causal):
+    """Best (block_q, block_k) from the persistent autotune cache, or
+    None.  Never raises into the kernel call path."""
+    try:
+        from ray_tpu.autotune.cache import attention_key, get_cache
+        key = attention_key(B, S, N, H, dtype, causal)
+        first = key not in _CACHE_CONSULTED
+        if first:
+            _CACHE_CONSULTED.add(key)
+        rec = get_cache().lookup("flash_attention", key, count=first)
+        if rec:
+            cfg = rec.get("config") or {}
+            bq, bk = cfg.get("block_q"), cfg.get("block_k")
+            if bq and bk and S % int(bq) == 0 and S % int(bk) == 0:
+                return int(bq), int(bk)
+    except Exception:
+        pass
+    return None
+
+
 def _resolve(q, causal, block_q, block_k, interpret, layout):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -417,8 +446,10 @@ def _resolve(q, causal, block_q, block_k, interpret, layout):
         else:
             B, S, N, H = q.shape
         key = (jax.default_backend(), B, S, N, H, str(q.dtype), causal)
-        bq, bk = _TUNED.get(key) or _default_blocks(S, H,
-                                                    strict=not interpret)
+        bqbk = (_TUNED.get(key)
+                or _cached_blocks(B, S, N, H, q.dtype, causal)
+                or _default_blocks(S, H, strict=not interpret))
+        bq, bk = bqbk
         block_q = block_q or bq
         block_k = block_k or bk
     return block_q, block_k, interpret
@@ -448,50 +479,36 @@ flash_attention.defvjp(_fwd, _bwd)
 
 def tune_flash_blocks(B, S, N, H, dtype=jnp.bfloat16, causal=True,
                       candidates=(128, 256, 512), steps=3):
-    """Time fwd+bwd for each (block_q, block_k) candidate pair on the live
-    backend and record the winner for subsequent block_q=None calls.
+    """Thin shim over the autotune subsystem (ray_tpu.autotune): time
+    fwd+bwd for each (block_q, block_k) candidate pair on the live
+    backend, persist the winner to the shared autotune cache, and record
+    it in _TUNED for subsequent block_q=None calls in this process.
 
-    Returns ((block_q, block_k), best_seconds_per_step).
+    Returns ((block_q, block_k), best_seconds_per_step) —
+    best_seconds_per_step is None when the answer came from a cache
+    (process-local _TUNED or the persistent file) rather than a fresh
+    sweep, preserving the original contract.
     """
-    import time
+    from ray_tpu.autotune import search as _search
+    from ray_tpu.autotune.cache import attention_key, get_cache
 
     key = (jax.default_backend(), B, S, N, H, str(jnp.dtype(dtype)), causal)
     if key in _TUNED:
         return _TUNED[key], None
-    rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
-    kk = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
-    vv = jnp.asarray(rng.standard_normal((B, S, N, H)), dtype)
-    best, best_t = None, float("inf")
-    for bq in candidates:
-        for bk in candidates:
-            if S % bq or S % bk or bq > S or bk > S:
-                continue
-
-            def loss(q, k, v):
-                return flash_attention(q, k, v, causal, bq, bk).astype(
-                    jnp.float32).sum()
-
-            f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-            def _sync(r):
-                # block_until_ready is unreliable through the axon tunnel;
-                # pulling one scalar forces completion.
-                float(jnp.asarray(r[0])[0, 0, 0, 0])
-
-            try:
-                r = f(q, kk, vv)  # compile + warm
-                _sync(r)
-                t0 = time.perf_counter()
-                for _ in range(steps):
-                    r = f(q, kk, vv)
-                _sync(r)
-                dt = (time.perf_counter() - t0) / steps
-            except Exception:
-                continue
-            if dt < best_t:
-                best, best_t = (bq, bk), dt
-    if best is None:
-        best = _default_blocks(S, H)
+    ckey = attention_key(B, S, N, H, dtype, causal)
+    cached = get_cache().lookup("flash_attention", ckey) is not None
+    cands = [{"block_q": bq, "block_k": bk}
+             for bq in candidates for bk in candidates
+             if not (S % bq or S % bk or bq > S or bk > S)]
+    rec = _search.tune("flash_attention", ckey, candidates=cands,
+                       iters=steps) if cands else None
+    if rec is None:
+        best, best_t = _default_blocks(S, H), None
+    else:
+        cfg = rec.get("config") or {}
+        best = (int(cfg.get("block_q", 0)) or _default_blocks(S, H)[0],
+                int(cfg.get("block_k", 0)) or _default_blocks(S, H)[1])
+        best_t = None if cached or rec.get("ms") is None \
+            else rec["ms"] / 1e3
     _TUNED[key] = best
     return best, best_t

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ray_tpu.util import jax_compat
+
+jax_compat.install()
+
 _NEG_INF = -1e30
 
 
@@ -92,11 +96,22 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp",
     from jax.sharding import PartitionSpec as P
 
     spec = P(tuple(batch_axes), axis_name, head_axis, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis_name),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh, (spec, spec, spec), spec)
     return fn(q, k, v)
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map (stable API, check_vma) with a fallback to the
+    pre-graduation jax.experimental.shard_map (check_rep) so ring/Ulysses
+    run on both sides of the rename."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
@@ -121,3 +136,25 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
     oh = jnp.einsum("bnqk,bknh->bqnh", p, vh)
     return lax.all_to_all(oh, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
+
+
+def make_ring_attention_fn(mesh, axis_name: str = "sp",
+                           batch_axes=("dp", "fsdp"),
+                           head_axis: Optional[str] = "tp"):
+    """Autotune-dispatch hook: close over the mesh/axis topology once and
+    return an `(q, k, v) -> o` callable with the plain attention
+    signature the dispatcher (ray_tpu.autotune.dispatch) and the timing
+    harness expect.  Raises ValueError up front when the mesh cannot
+    carry a ring (no `axis_name` axis, or size 1 — a 1-wide ring is just
+    dense attention with extra collectives)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = sizes.get(axis_name, 1)
+    if sp <= 1:
+        raise ValueError(
+            f"ring attention needs mesh axis {axis_name!r} with size > 1 "
+            f"(got {sizes})")
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              batch_axes=batch_axes, head_axis=head_axis)
+    return fn

@@ -25,6 +25,10 @@ import numpy as np
 
 import jax
 
+from ray_tpu.util import jax_compat
+
+jax_compat.install()
+
 DP_AXIS = "dp"
 
 

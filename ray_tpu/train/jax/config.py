@@ -44,6 +44,9 @@ def _init_jax_distributed(coordinator: str, num_processes: int,
         # at interpreter start; config.update wins as long as no backend has
         # been initialized yet (workers call this before any jax use).
         jax.config.update("jax_platforms", platform)
+    from ray_tpu.util import jax_compat
+
+    jax_compat.install()
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -1,0 +1,64 @@
+"""Forward-compat shims so code written against newer jax APIs runs on
+older installs (observed floor: jax 0.4.37).
+
+The repo targets the stable post-graduation surface — ``jax.shard_map``,
+``jax.sharding.set_mesh``, ``jax.lax.axis_size`` — because that is where
+jax is going and what the TPU images ship.  Older CPU environments (this
+CI container among them) predate all three.  ``install()`` patches the
+missing names onto jax itself, with semantics verified equivalent:
+
+- ``jax.shard_map``: the pre-graduation ``jax.experimental.shard_map``
+  with the ``check_vma`` kwarg translated to ``check_rep``.
+- ``jax.sharding.set_mesh``: on old jax, ``Mesh`` is already a context
+  manager that sets itself as the ambient physical mesh, so
+  ``set_mesh(mesh)`` is just ``mesh``.
+- ``jax.lax.axis_size``: ``psum(1, axis_name)`` — constant-folded to a
+  static python int inside shard_map, same as the real ``axis_size``.
+
+Everything is hasattr-guarded: on a jax that already provides the API,
+``install()`` is a complete no-op, so it is safe (and cheap) to call
+from every module that uses these names.
+"""
+
+from __future__ import annotations
+
+import functools
+
+_installed = False
+
+
+def install() -> None:
+    """Idempotently patch missing new-style APIs onto jax. Safe to call
+    any number of times, from any thread that holds the import lock
+    (i.e. at module import time, which is how every caller uses it)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f=None, /, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            if f is None:
+                return functools.partial(shard_map, **kwargs)
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.sharding, "set_mesh"):
+        # Mesh is its own context manager pre-0.5; entering it sets the
+        # ambient mesh exactly like set_mesh's context-manager form.
+        jax.sharding.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a literal 1 is folded to the static axis size.
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size

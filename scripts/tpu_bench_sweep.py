@@ -43,6 +43,7 @@ def run_point(env_extra: dict, label: str, timeout_s: int = 600):
     env = dict(os.environ)
     env["RAY_TPU_BENCH_CHILD"] = "1"
     env["RT_BENCH_LLAMA"] = "0"     # sweep the headline model only
+    env["RT_BENCH_LONGCTX"] = "0"   # curve runs once, in its own phase
     env.update({k: str(v) for k, v in env_extra.items()})
     t0 = time.time()
     try:
@@ -73,6 +74,28 @@ def run_point(env_extra: dict, label: str, timeout_s: int = 600):
     print(f"[{label}] {r.get('value')} samples/s  mfu={r.get('mfu')} "
           f"({r['_wall_s']}s)", flush=True)
     return r
+
+
+def seed_autotune_cache(shapes=("32x1024x12x64", "2x4096x12x64",
+                                "1x8192x12x64"),
+                        timeout_s: int = 1200) -> bool:
+    """Run scripts/autotune_sweep.py in a child (the tunnel tolerates one
+    TPU client at a time, same as the bench points) so the winning block
+    configs land in the persistent cache for train/serve to inherit."""
+    cmd = [sys.executable,
+           os.path.join(REPO, "scripts", "autotune_sweep.py"),
+           "--shapes", *shapes]
+    try:
+        p = subprocess.run(cmd, stdout=subprocess.PIPE,
+                           stderr=subprocess.STDOUT, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"[autotune-seed] TIMEOUT after {timeout_s}s", flush=True)
+        return False
+    print("[autotune-seed] " +
+          (p.stdout or "").strip().replace("\n", "\n[autotune-seed] "),
+          flush=True)
+    return p.returncode == 0
 
 
 def main() -> int:
@@ -114,6 +137,21 @@ def main() -> int:
     r = run_point({"RT_BENCH_CE_BLOCK": 0}, "control-ce0")
     if r is not None:
         results.append(r)
+
+    # ROADMAP item 4 rider: while the tunnel is still live, seed the
+    # persistent autotune cache (offline sweep over the bench + long-
+    # context shapes) and capture the seq-8192 flash datum via one
+    # dedicated longctx-curve child.
+    seed_autotune_cache()
+    r = run_point({"RT_BENCH_CE_BLOCK": 256, "RT_BENCH_LONGCTX": 1},
+                  "longctx-curve", timeout_s=1800)
+    if r is not None:
+        results.append(r)
+        for pt in r.get("longctx_curve") or []:
+            if pt.get("seq") == 8192 and pt.get("flash_ms") is not None:
+                print(f"seq-8192 flash datum: {pt['flash_ms']} ms "
+                      f"(dense {pt.get('dense_ms')} ms, chosen variant "
+                      f"{pt.get('variant')})", flush=True)
 
     out_path = os.path.join(REPO, "BENCH_SWEEP_r05.json")
     with open(out_path, "w") as f:

@@ -21,6 +21,10 @@ import jax  # noqa: E402
 # and the env var — in that case tests fail loudly on device count).
 jax.config.update("jax_platforms", "cpu")
 
+from ray_tpu.util import jax_compat  # noqa: E402
+
+jax_compat.install()
+
 import pytest  # noqa: E402
 
 if os.environ.get("RT_TEST_LOG_LEVEL"):

@@ -1,0 +1,339 @@
+"""Kernel autotune subsystem: cache durability, dispatcher crossover,
+end-to-end interpret-mode tuning, and dispatched-kernel numerics.
+
+All shapes are tiny and every kernel runs in interpret mode — the whole
+module is tier-1 fast (the `autotune` marker selects it alone)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu.autotune.cache as ac
+from ray_tpu.autotune import attention_key, get_cache, norm_batch
+from ray_tpu.autotune import metrics as am
+from ray_tpu.autotune import dispatch, search
+from ray_tpu.autotune.cache import AutotuneCache
+from ray_tpu.ops.flash_attention import _dense_reference
+
+pytestmark = pytest.mark.autotune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_file(tmp_path, monkeypatch):
+    """Fresh cache file + clean process-local state for every test."""
+    path = str(tmp_path / "autotune.jsonl")
+    monkeypatch.setenv("RT_AUTOTUNE_CACHE", path)
+    monkeypatch.delenv("RT_AUTOTUNE_ON_MISS", raising=False)
+    ac._CACHES.clear()
+    dispatch.clear_memo()
+    am.reset()
+    fa = sys.modules["ray_tpu.ops.flash_attention"]
+    fa._TUNED.clear()
+    fa._CACHE_CONSULTED.clear()
+    yield path
+    ac._CACHES.clear()
+    dispatch.clear_memo()
+
+
+def _qkv(seed, B=1, S=32, N=2, H=8, dtype=jnp.float32, layout="bsnh"):
+    rng = np.random.default_rng(seed)
+    shape = (B, N, S, H) if layout == "bnsh" else (B, S, N, H)
+    return tuple(jnp.asarray(rng.standard_normal(shape), dtype)
+                 for _ in range(3))
+
+
+# ----------------------------------------------------------------- cache
+
+def test_cache_roundtrip_and_last_wins(cache_file):
+    c = get_cache()
+    key = attention_key(2, 64, 2, 8, "float32", True)
+    c.put("flash_attention", key, {"block_q": 16, "block_k": 16}, 1.5)
+    c.put("flash_attention", key, {"block_q": 32, "block_k": 32}, 0.9)
+    rec = c.lookup("flash_attention", key)
+    assert rec["config"] == {"block_q": 32, "block_k": 32}
+    assert rec["ms"] == 0.9
+    # a fresh view over the same file agrees (restart survival)
+    c2 = AutotuneCache(cache_file)
+    rec2 = c2.lookup("flash_attention", key, count=False)
+    assert rec2["config"] == {"block_q": 32, "block_k": 32}
+    # the file holds both appends until a rewrite compacts them
+    assert sum(1 for _ in open(cache_file)) == 2
+    assert c.rewrite() == 1
+    assert sum(1 for _ in open(cache_file)) == 1
+
+
+def test_cache_truncated_tail_recovery(cache_file):
+    """The torn tail of a crashed append costs that line, not the cache."""
+    c = get_cache()
+    k1 = attention_key(1, 32, 2, 8, "float32", True)
+    k2 = attention_key(1, 64, 2, 8, "float32", True)
+    c.put("flash_attention", k1, {"block_q": 8, "block_k": 8}, 2.0)
+    full_line = json.dumps({"v": 1, "op": "flash_attention",
+                            "backend": ac.backend_fingerprint(),
+                            "key": k2, "config": {}, "ms": 1.0})
+    with open(cache_file, "a") as f:
+        f.write(full_line[: len(full_line) // 2])   # crash mid-append
+    c2 = AutotuneCache(cache_file)
+    assert c2.corrupt_lines == 1
+    assert c2.lookup("flash_attention", k1, count=False) is not None
+    assert c2.lookup("flash_attention", k2, count=False) is None
+    # rewrite drops the torn tail for good
+    assert c2.rewrite() == 1
+    assert AutotuneCache(cache_file).corrupt_lines == 0
+
+
+def test_cache_foreign_schema_and_garbage_skipped(cache_file):
+    with open(cache_file, "w") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"v": 999, "op": "x", "backend": "b",
+                            "key": "k", "config": {}}) + "\n")
+        f.write(json.dumps({"v": 1, "op": "flash_attention",
+                            "backend": "cpu:interpret", "key": "K",
+                            "config": {"block_q": 8, "block_k": 8},
+                            "ms": 1.0}) + "\n")
+    c = AutotuneCache(cache_file)
+    assert len(c) == 1
+    assert c.corrupt_lines == 1          # garbage; foreign version is
+    rec = c.lookup("flash_attention", "K", backend="cpu:interpret",
+                   count=False)          # skipped silently, not corrupt
+    assert rec["ms"] == 1.0
+
+
+def test_cache_cross_process_persistence(cache_file):
+    """Tune in one process, hit the cache in a second (the acceptance
+    criterion: the cache survives process restart)."""
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from ray_tpu.autotune import search\n"
+        "rec = search.tune_flash(1, 32, 2, 8, 'float32', True,"
+        " interpret=True)\n"
+        "assert rec is not None and rec['config'], rec\n"
+        "print(rec['config'])\n"
+    )
+    env = dict(os.environ, RT_AUTOTUNE_CACHE=cache_file,
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-2000:]
+    # this (second) process sees the first one's sweep as a pure hit
+    c = get_cache()
+    key = attention_key(1, 32, 2, 8, "float32", True)
+    rec = c.lookup("flash_attention", key, backend="cpu:interpret")
+    assert rec is not None
+    assert "block_q" in rec["config"]
+    assert am.stats()["autotune_cache_hits"] == 1
+    assert am.stats()["autotune_cache_misses"] == 0
+
+
+def test_cache_concurrent_append_interleaves_whole_lines(cache_file):
+    c = get_cache()
+    other = AutotuneCache(cache_file)      # second writer, same file
+    for i in range(10):
+        k = attention_key(1, 32 * (i + 1), 2, 8, "float32", True)
+        (c if i % 2 else other).put("flash_attention", k,
+                                    {"block_q": 8, "block_k": 8}, i + 1.0)
+    fresh = AutotuneCache(cache_file)
+    assert fresh.corrupt_lines == 0
+    assert len(fresh) == 10
+
+
+def test_key_normalization():
+    # batch buckets to the next power of two; other dims are exact
+    assert norm_batch(1) == 1 and norm_batch(3) == 4 and norm_batch(8) == 8
+    assert attention_key(3, 128, 4, 64, jnp.bfloat16, True) == \
+        attention_key(4, 128, 4, 64, "bfloat16", 1)
+    assert attention_key(1, 128, 4, 64, "float32", True) != \
+        attention_key(1, 128, 4, 64, "float32", False)
+
+
+# ------------------------------------------------------------ dispatcher
+
+def test_crossover_on_synthetic_timings():
+    pick = dispatch.choose_variant_from_timings
+    assert pick({"flash": 2.0, "dense": 5.0, "ring": None}) == "flash"
+    assert pick({"flash": 2.0, "dense": 1.0}) == "dense"
+    assert pick({"flash": 2.0, "dense": 1.0},
+                allowed=("flash",)) == "flash"
+    assert pick({"flash": None, "dense": float("inf")}) is None
+    assert pick({}) is None
+
+
+def test_choose_honors_cache_record(cache_file):
+    key = attention_key(1, 32, 2, 8, "float32", True)
+    get_cache().put(dispatch.VARIANT_OP, key, {"variant": "flash"}, 1.0)
+    v, rec = dispatch.choose(1, 32, 2, 8, "float32", True,
+                             allowed=("flash", "dense"), interpret=True)
+    assert v == "flash" and rec is not None
+    # memoized: a second call doesn't touch the counters again
+    before = am.stats()["autotune_cache_hits"]
+    v2, _ = dispatch.choose(1, 32, 2, 8, "float32", True,
+                            allowed=("flash", "dense"), interpret=True)
+    assert v2 == "flash"
+    assert am.stats()["autotune_cache_hits"] == before
+
+
+def test_choose_miss_falls_back_to_heuristic(cache_file):
+    # cold cache + default on-miss mode: short seq on CPU -> dense,
+    # and the miss is counted exactly once (memoized after that)
+    v, rec = dispatch.choose(1, 32, 2, 8, "float32", True,
+                             allowed=("flash", "dense"), interpret=True)
+    assert v == "dense" and rec is None
+    assert am.stats()["autotune_cache_misses"] == 1
+    dispatch.choose(1, 32, 2, 8, "float32", True,
+                    allowed=("flash", "dense"), interpret=True)
+    assert am.stats()["autotune_cache_misses"] == 1
+
+
+def test_on_miss_inline_tunes_and_persists(cache_file, monkeypatch):
+    monkeypatch.setenv("RT_AUTOTUNE_ON_MISS", "inline")
+    monkeypatch.setenv("RT_AUTOTUNE_BUDGET_S", "60")
+    v, rec = dispatch.choose(1, 32, 2, 8, "float32", True,
+                             allowed=("flash", "dense"), interpret=True)
+    assert rec is not None and rec["config"]["variant"] == v
+    assert am.stats()["autotune_tune_ms"] > 0
+    # the decision is now durable: a fresh process-view hits it
+    c2 = AutotuneCache(cache_file)
+    key = attention_key(1, 32, 2, 8, "float32", True)
+    assert c2.lookup(dispatch.VARIANT_OP, key, count=False) is not None
+
+
+def test_end_to_end_tune_tiny_shape(cache_file):
+    rec = search.tune("flash_attention",
+                      attention_key(1, 32, 2, 8, "float32", True),
+                      interpret=True)
+    assert rec is not None
+    assert rec["config"]["block_q"] >= 8
+    assert rec["ms"] > 0
+    assert rec["meta"]["swept"] >= 1
+
+
+def test_tune_flash_blocks_shim(cache_file):
+    fa = sys.modules["ray_tpu.ops.flash_attention"]
+    (bq, bk), t = fa.tune_flash_blocks(1, 64, 2, 8, jnp.float32, True,
+                                       candidates=(16, 32), steps=1)
+    assert (bq, bk) in {(a, b) for a in (16, 32) for b in (16, 32)}
+    assert t is not None and t > 0
+    # the winner reached both the process-local memo and the shared file
+    key = ("cpu", 1, 64, 2, 8, "float32", True)
+    assert fa._TUNED[key] == (bq, bk)
+    rec = get_cache().lookup(
+        "flash_attention", attention_key(1, 64, 2, 8, "float32", True),
+        count=False)
+    assert rec["config"] == {"block_q": bq, "block_k": bk}
+    # second call answers from the memo (no timing)
+    assert fa.tune_flash_blocks(1, 64, 2, 8, jnp.float32, True)[1] is None
+
+
+def test_flash_resolve_consults_cache(cache_file):
+    """A tuned record drives block selection for block_q=None calls."""
+    fa = sys.modules["ray_tpu.ops.flash_attention"]
+    key = attention_key(1, 64, 2, 8, "float32", True)
+    get_cache().put("flash_attention", key,
+                    {"block_q": 16, "block_k": 16}, 1.0)
+    q, k, v = _qkv(0, S=64)
+    bq, bk, _ = fa._resolve(q, True, None, None, True, "bsnh")
+    assert (bq, bk) == (16, 16)
+    o = fa.flash_attention(q, k, v, True, None, None, None, True)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_dense_reference(q, k, v, True,
+                                                           None)),
+                               atol=2e-5)
+
+
+def test_strict_divisibility_error_suggests_padding():
+    from ray_tpu.ops.flash_attention import _default_blocks
+    with pytest.raises(ValueError, match=r"Pad the sequence to 128.*"
+                                         r"block_q=128"):
+        _default_blocks(100, 64, strict=True)
+    with pytest.raises(ValueError, match=r"Pad the sequence to 8"):
+        _default_blocks(7, 64, strict=True)
+
+
+# ----------------------------------------------------- dispatched kernels
+
+def test_dispatched_variants_match_dense_reference(cache_file):
+    """Numerical equivalence of the dispatched kernel vs _dense_reference
+    for every variant selectable on CPU (dense, flash, ring)."""
+    q, k, v = _qkv(1, B=2, S=32, N=2, H=8)
+    ref = np.asarray(_dense_reference(q, k, v, True, None))
+    for variant, kw in (("dense", {}), ("flash", {}),
+                        ("ring", {"mesh": None})):
+        if variant == "ring":
+            from ray_tpu.parallel import MeshSpec
+            kw = {"mesh": MeshSpec(sp=4).build()}
+        try:
+            out = dispatch.attention(q, k, v, causal=True, variant=variant,
+                                     interpret=True, **kw)
+        except AttributeError:
+            # ring rides shard_map/axis_size, which some jax versions in
+            # CI lack (same versions fail test_ops ring tests); the other
+            # variants must still be checked
+            assert variant == "ring"
+            continue
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5,
+                                   err_msg=variant)
+
+
+@pytest.mark.skipif(not search.splash_supported(
+    {"H": 128, "S": 128, "causal": True}),
+    reason="splash attention kernels unavailable in this jax build")
+def test_dispatched_splash_matches_dense_reference(cache_file):
+    # splash needs H % 128 == 0 in this jax version; keep it one head
+    # and one batch so the interpret-mode kernel stays fast
+    q, k, v = _qkv(2, B=1, S=128, N=1, H=128)
+    ref = np.asarray(_dense_reference(q, k, v, True, None))
+    out = dispatch.attention(q, k, v, causal=True, variant="splash",
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+
+def test_attention_auto_consults_variant_record(cache_file):
+    """With a flash crossover record planted, the dispatcher takes flash
+    even where the heuristic would say dense — measured beats static."""
+    key = attention_key(1, 32, 2, 8, "float32", True)
+    get_cache().put(dispatch.VARIANT_OP, key, {"variant": "flash"}, 1.0)
+    get_cache().put("flash_attention", key,
+                    {"block_q": 16, "block_k": 16}, 1.0)
+    q, k, v = _qkv(3)
+    out = dispatch.attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(_dense_reference(q, k, v, True, None)), atol=2e-5)
+    assert dispatch.choose(1, 32, 2, 8, "float32", True,
+                           interpret=True)[0] == "flash"
+
+
+def test_model_auto_variant_uses_record(cache_file):
+    from ray_tpu.models.gpt import GPTConfig, _auto_attention_variant
+    cfg = GPTConfig(num_heads=2, embed_dim=16, dtype=jnp.float32)
+    # cold cache: inherits the static heuristic (CPU short seq -> dense)
+    assert _auto_attention_variant(1, 32, cfg) == "dense"
+    key = attention_key(1, 32, 2, 8, "float32", True)
+    get_cache().put(dispatch.VARIANT_OP, key, {"variant": "flash"}, 1.0)
+    dispatch.clear_memo()
+    assert _auto_attention_variant(1, 32, cfg) == "flash"
+
+
+def test_metrics_flow_to_node_stats_shape():
+    """autotune counters are plain floats/ints keyed by the exported
+    names — the contract raylet._node_stats and the GCS fold rely on."""
+    am.reset()
+    am.bump("autotune_cache_hits")
+    am.bump("autotune_tune_ms", 12.5)
+    st = am.stats()
+    assert st["autotune_cache_hits"] == 1
+    assert st["autotune_tune_ms"] == 12.5
+    assert set(st) == set(am.COUNTER_NAMES)
+    from ray_tpu._private.gcs import GcsServer
+    for name in am.COUNTER_NAMES:
+        assert name in GcsServer._FOLDED_COUNTERS
