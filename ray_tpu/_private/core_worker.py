@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private.async_utils import spawn
 from ray_tpu._private import wire
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, task_id_generator
@@ -555,8 +556,9 @@ class CoreWorker:
         oid_hex, kind, data = msg["entry"]
         if st is None or st["cancelled"]:
             if kind not in ("inline", "pval", "ndval"):
-                asyncio.ensure_future(self.gcs.notify(
-                    {"type": "object_freed", "object_id": oid_hex}))
+                spawn(self.gcs.notify(
+                    {"type": "object_freed", "object_id": oid_hex}),
+                    name="notify-object-freed", log=logger)
             return {"ok": False, "cancelled": True}
         self.owned.add(oid_hex)
         self._store_return_entry(oid_hex, kind, data)
@@ -759,8 +761,9 @@ class CoreWorker:
                 # files) through the GCS object directory — a spilled
                 # object has no local plasma copy, so this must fire even
                 # when the local delete was a no-op.
-                asyncio.ensure_future(self.gcs.notify({
-                    "type": "object_freed", "object_id": h}), loop=self.loop)
+                spawn(self.gcs.notify({
+                    "type": "object_freed", "object_id": h}),
+                    name="notify-object-freed", loop=self.loop, log=logger)
             except Exception:
                 pass
 
@@ -1516,8 +1519,9 @@ class CoreWorker:
                 st["cancelled"] = True
                 conn = self.actor_state.get(st["actor"], {}).get("conn")
                 if conn is not None and not conn.closed:
-                    asyncio.ensure_future(conn.notify(
-                        {"type": "cancel_task", "task_id": tid}))
+                    spawn(conn.notify(
+                        {"type": "cancel_task", "task_id": tid}),
+                        name="notify-cancel-task", log=logger)
 
             self.loop.call_soon_threadsafe(_do_actor)
             return True
@@ -1527,9 +1531,10 @@ class CoreWorker:
             st["force"] = force
             conn = st.get("worker_conn")
             if conn is not None and not conn.closed:
-                asyncio.ensure_future(conn.notify(
+                spawn(conn.notify(
                     {"type": "cancel_task", "task_id": tid,
-                     "force": force}))
+                     "force": force}),
+                    name="notify-cancel-task", log=logger)
             else:
                 t = st.get("atask")
                 if t is not None:
@@ -1711,7 +1716,7 @@ class CoreWorker:
                     return
                 g = fut.result()
                 if isinstance(g, dict) and "lease_id" in g:
-                    asyncio.ensure_future(conn.request({
+                    spawn(conn.request({
                         "type": "return_lease",
                         "lease_id": g["lease_id"],
                         "worker_id": g["worker_id"],
@@ -1873,7 +1878,7 @@ class CoreWorker:
             # uses; the GCS forwards the free to every holder raylet).
             for oid_hex, kind, _data in entries[len(return_ids):]:
                 if kind not in ("inline", "pval", "ndval"):
-                    asyncio.ensure_future(
+                    spawn(
                         self.gcs.notify({"type": "object_freed",
                                          "object_id": oid_hex}),
                         loop=self.loop)
@@ -1929,9 +1934,13 @@ class CoreWorker:
 
     async def create_actor_async(self, cls, args, kwargs, **opts) -> str:
         """Loop-thread-safe actor creation (async actor methods that call
-        .remote() would deadlock on the blocking path's _run)."""
-        req, pinned_args = self._build_create_actor_request(
-            cls, args, kwargs, **opts)
+        .remote() would deadlock on the blocking path's _run).
+
+        Spec building cloudpickles the actor class — unbounded work
+        (imports, closures) — so it runs on the executor, not the loop."""
+        req, pinned_args = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._build_create_actor_request(
+                cls, args, kwargs, **opts))
         reply = await self.gcs.request(req)
         self._pin_actor_creation(reply["actor_id"], pinned_args)
         return reply["actor_id"]
@@ -2026,8 +2035,8 @@ class CoreWorker:
         for entry in batch:
             groups.setdefault(entry[0], []).append(entry)
         for actor_id_hex, entries in groups.items():
-            asyncio.ensure_future(
-                self._submit_actor_group(actor_id_hex, entries))
+            spawn(self._submit_actor_group(actor_id_hex, entries),
+                  name="submit-actor-group", log=logger)
 
     async def _submit_actor_group(self, actor_id_hex: str, entries: list):
         """Send a burst of same-actor calls as one _BATCH frame.
@@ -2072,8 +2081,9 @@ class CoreWorker:
             futs = conn.request_batch(msgs)
         except Exception:   # connection died between dial and send
             for call, return_ids, pin in metas:
-                asyncio.ensure_future(self._group_fallback(
-                    st, actor_id_hex, call, return_ids, pinned=pin))
+                spawn(self._group_fallback(
+                    st, actor_id_hex, call, return_ids, pinned=pin),
+                    name="actor-group-fallback", log=logger)
             return
         for fut, meta in zip(futs, metas):
             fut.add_done_callback(functools.partial(
@@ -2089,8 +2099,9 @@ class CoreWorker:
         except (ConnectionLost, asyncio.CancelledError):
             st["conn"] = None
             st["address"] = None
-            asyncio.ensure_future(self._group_fallback(
-                st, actor_id_hex, call, return_ids, pinned=pinned))
+            spawn(self._group_fallback(
+                st, actor_id_hex, call, return_ids, pinned=pinned),
+                name="actor-group-fallback", log=logger)
             return
         except Exception as e:  # noqa: BLE001
             payload = cloudpickle.dumps((e, traceback.format_exc()))
@@ -2099,9 +2110,10 @@ class CoreWorker:
             self._finish_actor_entry(st, actor_id_hex, call, return_ids)
             return
         if reply.get("retriable"):
-            asyncio.ensure_future(self._group_fallback(
+            spawn(self._group_fallback(
                 st, actor_id_hex, call, return_ids, retriable=True,
-                pinned=pinned))
+                pinned=pinned),
+                name="actor-group-fallback", log=logger)
             return
         if reply.get("ok"):
             self._store_task_returns(reply, return_ids)
@@ -2133,9 +2145,10 @@ class CoreWorker:
         st["pending_calls"] -= 1
         if st["kill_on_drain"] and st["pending_calls"] == 0:
             st["kill_on_drain"] = False
-            asyncio.ensure_future(self.gcs.notify(
+            spawn(self.gcs.notify(
                 {"type": "kill_actor", "actor_id": actor_id_hex,
-                 "no_restart": True}))
+                 "no_restart": True}),
+                name="notify-kill-actor", log=logger)
 
     async def _submit_actor_call(self, actor_id_hex, call, return_ids,
                                  _retry: int = 0, pinned_args=None):

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+
+from ray_tpu._private.async_utils import spawn
 import json
 import logging
 import os
@@ -90,10 +92,15 @@ async def amain(args) -> None:
         "dashboard_address": dashboard_address,
         "pid": os.getpid(),
     }
-    tmp = args.ready_file + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(ready, f)
-    os.replace(tmp, args.ready_file)
+    def _write_ready():
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ready, f)
+        os.replace(tmp, args.ready_file)
+
+    # The raylet/GCS serve on this loop already — even the one-shot
+    # ready-file write goes through the executor.
+    await asyncio.get_running_loop().run_in_executor(None, _write_ready)
 
     stop = asyncio.Event()
 
@@ -114,7 +121,7 @@ async def amain(args) -> None:
             await asyncio.sleep(1.0)
 
     if not args.no_parent_watch:
-        asyncio.get_running_loop().create_task(watch_parent())
+        spawn(watch_parent(), name="daemon-parent-watch")
     await stop.wait()
     await raylet.close()
     if dashboard is not None:

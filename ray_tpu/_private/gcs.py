@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private.async_utils import spawn
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu._private.protocol import RpcConnection, RpcServer
 
@@ -220,7 +221,12 @@ class GcsServer:
 
     async def start(self, port: int = 0) -> int:
         if self._persist_path:
-            self._load_snapshot()
+            # Read + parse on the executor (a large KV snapshot would
+            # stall the loop before it even serves); apply on the loop.
+            snap = await asyncio.get_running_loop().run_in_executor(
+                None, self._read_snapshot_file)
+            if snap is not None:
+                self._apply_snapshot(snap)
         port = await self.server.start(port)
         # The health verdict below compares heartbeat age against a
         # timeout — but heartbeats are PROCESSED on this loop, so our own
@@ -245,7 +251,7 @@ class GcsServer:
             self._snapshot_task.cancel()
         if self._persist_path:
             try:
-                self._write_snapshot()
+                await self._write_snapshot_async()
             except Exception:
                 logger.exception("final GCS snapshot failed")
         await self.server.close()
@@ -286,13 +292,6 @@ class GcsServer:
                 if pg.state != "REMOVED"],
         }
 
-    def _write_snapshot(self):
-        tmp = self._persist_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._snapshot_state(), f)
-        _os.replace(tmp, self._persist_path)
-        self._dirty = False
-
     async def _write_snapshot_async(self):
         """Snapshot without stalling the event loop: the state dict is
         built synchronously (no awaits — consistent view), but the JSON
@@ -308,13 +307,17 @@ class GcsServer:
 
         await asyncio.get_running_loop().run_in_executor(None, _dump)
 
-    def _load_snapshot(self):
-        import base64
+    def _read_snapshot_file(self) -> Optional[dict]:
+        """File IO half of snapshot restore — runs on the executor so a
+        large snapshot never stalls the serving loop (see start())."""
         try:
             with open(self._persist_path) as f:
-                snap = json.load(f)
+                return json.load(f)
         except (FileNotFoundError, json.JSONDecodeError):
-            return
+            return None
+
+    def _apply_snapshot(self, snap: dict):
+        import base64
         ub = base64.b64decode
         self.kv = {ns: {ub(k): ub(v) for k, v in table.items()}
                    for ns, table in snap.get("kv", {}).items()}
@@ -406,8 +409,9 @@ class GcsServer:
         logger.warning(
             "node %s connection lost; holding DISCONNECTED for %.1fs "
             "reconnect grace", node.node_id, grace)
-        asyncio.get_event_loop().create_task(self._publish(
-            "nodes", {"event": "disconnected", "node": node.public()}))
+        spawn(self._publish(
+            "nodes", {"event": "disconnected", "node": node.public()}),
+            name="gcs-publish-disconnected", log=logger)
 
         async def _grace_expiry():
             await asyncio.sleep(grace)
@@ -701,8 +705,8 @@ class GcsServer:
             # was transiently empty (mid task-burst heartbeat) must retry
             # when the next heartbeat shows capacity, not wait for a node
             # registration that never comes on a static cluster.
-            asyncio.get_running_loop().create_task(
-                self._try_schedule_pending())
+            spawn(self._try_schedule_pending(),
+                  name="gcs-schedule-pending", log=logger)
         return {"ok": True}
 
     async def _h_get_nodes(self, conn, msg):
@@ -857,7 +861,8 @@ class GcsServer:
         )
         self.actors[actor_id] = actor
         logger.debug("create_actor %s: scheduling", actor_id)
-        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        spawn(self._schedule_actor(actor), name="gcs-schedule-actor",
+              log=logger)
         return {"ok": True, "existing": False, "actor_id": actor_id.hex()}
 
     def _pick_node_for(self, resources: Dict[str, float],
@@ -1074,7 +1079,7 @@ class GcsServer:
             strategy=msg.get("strategy", "PACK"),
         )
         self.placement_groups[pg.pg_id] = pg
-        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        spawn(self._schedule_pg(pg), name="gcs-schedule-pg", log=logger)
         return {"ok": True}
 
     async def _schedule_pg(self, pg: PlacementGroupInfo):

@@ -106,10 +106,16 @@ class LogMonitor:
             return False
         if size <= s.pos:
             return False
-        try:
+        def _read_chunk():
             with open(s.path, "rb") as f:
                 f.seek(s.pos)
-                data = f.read(READ_CAP)
+                return f.read(READ_CAP)
+
+        try:
+            # This loop is shared with the raylet — keep even bounded log
+            # file reads off it (NFS/cold-page reads block arbitrarily).
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, _read_chunk)
         except OSError:
             return False
         if not data:

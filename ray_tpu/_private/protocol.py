@@ -23,6 +23,7 @@ import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from ray_tpu._private import wire
+from ray_tpu._private.async_utils import spawn
 
 logger = logging.getLogger(__name__)
 
@@ -231,7 +232,7 @@ class RpcConnection:
         self._undrained += _HEADER.size + len(payload)
         if self._undrained >= 1 << 20:
             self._undrained = 0
-            asyncio.get_running_loop().create_task(self._drain())
+            spawn(self._drain(), name="rpc-drain", log=logger)
 
     async def _drain(self):
         async with self._send_lock:
@@ -423,8 +424,10 @@ class RpcConnection:
                 if kind == _REQUEST:
                     fh = self.fast_handler
                     if fh is None or not fh(rid, msg):
+                        # per-request dispatch: _handle replies errors
+                        # itself; skip the done-callback tax on this path
                         asyncio.get_running_loop().create_task(
-                            self._handle(rid, msg))
+                            self._handle(rid, msg))  # rtlint: disable=orphan-task
                 elif kind == _REPLY:
                     fut = self._pending.pop(rid, None)
                     if fut is not None and not fut.done():
@@ -438,7 +441,8 @@ class RpcConnection:
                             msg.get("type") == wire.HELLO_TYPE:
                         self._apply_hello(msg)
                         continue
-                    asyncio.get_running_loop().create_task(self._handle(None, msg))
+                    asyncio.get_running_loop().create_task(
+                        self._handle(None, msg))  # rtlint: disable=orphan-task
                 elif kind == _BATCH:
                     self._dispatch_batch(msg)
         except (
@@ -472,13 +476,14 @@ class RpcConnection:
             elif kind == _REQUEST:
                 fh = self.fast_handler
                 if fh is None or not fh(rid, msg):
-                    loop.create_task(self._handle(rid, msg))
+                    # same hot-dispatch exemption as _serve above
+                    loop.create_task(self._handle(rid, msg))  # rtlint: disable=orphan-task
             elif kind == _NOTIFY:
                 if msg.__class__ is dict and \
                         msg.get("type") == wire.HELLO_TYPE:
                     self._apply_hello(msg)
                     continue
-                loop.create_task(self._handle(None, msg))
+                loop.create_task(self._handle(None, msg))  # rtlint: disable=orphan-task
 
     async def _handle(self, rid: Optional[int], msg: dict):
         try:

@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private.async_utils import spawn
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private import object_transfer
 from ray_tpu._private.object_transfer import ChecksumError
@@ -378,8 +379,11 @@ class Raylet:
         if self.gcs_conn:
             await self.gcs_conn.close()
         self.plasma.close()
+        import functools
         import shutil
-        shutil.rmtree(self.spill_dir, ignore_errors=True)
+        await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(shutil.rmtree, self.spill_dir,
+                                    ignore_errors=True))
 
     # -------------------------------------------------- per-node stats
 
@@ -394,7 +398,16 @@ class Raylet:
         while not self._shutdown:
             await asyncio.sleep(interval)
             try:
-                stats = self._collect_node_stats(prev)
+                # Snapshot the worker table on the loop (it mutates under
+                # us otherwise), then do the /proc + meminfo file reads on
+                # the executor — they are synchronous IO and would stall
+                # every lease/heartbeat sharing this loop (the exact
+                # condition loop_lag_ms exists to catch).
+                snap = list(self.workers.values())
+                stats = await asyncio.get_running_loop().run_in_executor(
+                    None, self._collect_node_stats, prev, snap)
+                if self._watchdog is not None:
+                    stats.update(self._watchdog.record())
                 await self.gcs_conn.notify({
                     "type": "report_node_stats",
                     "node_id": self.node_id.hex(),
@@ -403,12 +416,19 @@ class Raylet:
             except Exception:
                 logger.debug("node stats report failed", exc_info=True)
 
-    def _collect_node_stats(self, prev: Dict) -> dict:
+    def _collect_node_stats(self, prev: Dict,
+                            worker_snap: Optional[list] = None) -> dict:
+        """Executor-side half of the stats push: everything here must be
+        safe off the loop thread (file reads, GIL-atomic counter reads).
+        ``worker_snap`` is the loop-side snapshot of the worker table;
+        direct (test / same-thread) callers may omit it."""
+        if worker_snap is None:
+            worker_snap = list(self.workers.values())
         hz = os.sysconf("SC_CLK_TCK")
         page = os.sysconf("SC_PAGE_SIZE")
         now = time.monotonic()
         workers = []
-        for w in self.workers.values():
+        for w in worker_snap:
             pid = w.proc.pid
             if w.proc.poll() is not None:
                 continue
@@ -485,8 +505,8 @@ class Raylet:
             out.update(_autotune_metrics.stats())
         except Exception:
             pass
-        if self._watchdog is not None:
-            out.update(self._watchdog.record())
+        # loop_lag_ms is merged by the caller on the loop thread —
+        # LoopWatchdog.record() mutates watchdog state.
         return out
 
     def _purge_dead_leases(self) -> None:
@@ -637,8 +657,8 @@ class Raylet:
                 # without a dispatch the lease waits forever on a node with
                 # free capacity.
                 if self.pending_leases:
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch_leases())
+                    spawn(self._dispatch_leases(),
+                          name="raylet-dispatch", log=logger)
             # Only report deaths of actors that finished creation.  A worker
             # dying mid-create already fails the pending create_actor_worker
             # request — a duplicate death report would race the GCS's
@@ -669,7 +689,8 @@ class Raylet:
                     self.resources_available.get(k, 0.0) - v
             # PG leases that raced ahead of this push are queued; the new
             # bundle pool may satisfy them now.
-            asyncio.get_running_loop().create_task(self._dispatch_leases())
+            spawn(self._dispatch_leases(), name="raylet-dispatch",
+                  log=logger)
             return {"ok": True}
         if mtype == "return_bundle":
             key = (msg["pg_id"], msg["bundle_index"])
@@ -989,8 +1010,8 @@ class Raylet:
                             f"bundle {req.bundle_index} of pg "
                             f"{req.pg_id[:16]} is not on this node")
                 self._queue_lease(req)
-                asyncio.get_running_loop().create_task(
-                    self._dispatch_leases())   # close the await-gap race
+                spawn(self._dispatch_leases(), name="raylet-dispatch",
+                      log=logger)   # close the await-gap race
                 return await req.future
             if msg.get("no_spill"):
                 # Hard node affinity, or the end of a spillback chain:
@@ -1000,8 +1021,8 @@ class Raylet:
                     raise rex.SchedulingError(
                         f"this node can never satisfy {req.resources}")
                 self._queue_lease(req)
-                asyncio.get_running_loop().create_task(
-                    self._dispatch_leases())   # close the await-gap race
+                spawn(self._dispatch_leases(), name="raylet-dispatch",
+                      log=logger)   # close the await-gap race
                 return await req.future
             nodes = await self._get_nodes_cached()
             scored = [
@@ -1029,7 +1050,8 @@ class Raylet:
             # Self-wake: resources may have freed during the awaits above
             # (a return_lease dispatching an empty queue would otherwise
             # never revisit this request).
-            asyncio.get_running_loop().create_task(self._dispatch_leases())
+            spawn(self._dispatch_leases(), name="raylet-dispatch",
+                  log=logger)
             return await req.future
         return await self._grant(req)
 

@@ -27,6 +27,7 @@ import traceback
 
 import cloudpickle
 
+from ray_tpu._private.async_utils import spawn
 from ray_tpu._private.core_worker import CoreWorker, _serialize_exception
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.protocol import connect
@@ -251,8 +252,8 @@ class TaskExecutor:
             status = "FAILED"
             # Ship buffered task events before dying — the periodic flusher
             # won't get another tick (its period exceeds the exit grace).
-            asyncio.get_running_loop().create_task(
-                self.core.flush_task_events())
+            spawn(self.core.flush_task_events(),
+                  name="worker-flush-task-events", log=logger)
             asyncio.get_running_loop().call_later(0.2, os._exit,
                                                   e.code or 0)
             return {"ok": False, "error": _serialize_exception(
@@ -429,12 +430,18 @@ class TaskExecutor:
     async def _create_actor(self, msg: dict) -> dict:
         try:
             import hashlib
-            spec = cloudpickle.loads(msg["creation_spec"])
+            # Class/closure unpickling is unbounded work (imports, class
+            # bodies) — run it on the executor so actor creation never
+            # freezes the IO loop that is concurrently serving fast-lane
+            # calls for other actors on this worker.
+            loop = asyncio.get_running_loop()
+            spec = await loop.run_in_executor(
+                None, cloudpickle.loads, msg["creation_spec"])
             cls_key = hashlib.sha1(spec["cls"]).hexdigest()
             cls = _ACTOR_CLS_CACHE.get(cls_key)
             if cls is None:
-                cls = _ACTOR_CLS_CACHE[cls_key] = \
-                    cloudpickle.loads(spec["cls"])
+                cls = _ACTOR_CLS_CACHE[cls_key] = await loop.run_in_executor(
+                    None, cloudpickle.loads, spec["cls"])
             # Bounded like normal tasks: a creation blocked on a lost arg
             # must release its worker so reconstruction can run (the GCS
             # retries the creation on a fresh worker).
@@ -540,8 +547,8 @@ class TaskExecutor:
             if self.core._borrow_acks:
                 # Borrows registered while resolving container args must
                 # reach the owner before the reply releases the pins.
-                asyncio.ensure_future(
-                    self._fast_reply_slow(conn, rid, msg, t0, result))
+                spawn(self._fast_reply_slow(conn, rid, msg, t0, result),
+                      name="fast-reply-slow", log=logger)
                 return
             # Return-0 object id by string surgery (ObjectID.for_task_return
             # flips the top bit and stamps the index into the low two bytes,
@@ -551,8 +558,8 @@ class TaskExecutor:
             entry, _ser = self.core.pack_return_sync(h, result)
             if entry is None:
                 # Plasma-bound return: needs the awaiting store path.
-                asyncio.ensure_future(
-                    self._fast_reply_slow(conn, rid, msg, t0, result))
+                spawn(self._fast_reply_slow(conn, rid, msg, t0, result),
+                      name="fast-reply-slow", log=logger)
                 return
             reply = {"ok": True, "returns": [entry]}
         except asyncio.CancelledError:
@@ -564,7 +571,8 @@ class TaskExecutor:
                          f"({call_id[:8]}) was cancelled"))}
         except SystemExit:
             status = "FAILED"
-            asyncio.ensure_future(self._report_intended_exit())
+            spawn(self._report_intended_exit(),
+                  name="report-intended-exit", log=logger)
             from ray_tpu.exceptions import ActorDiedError
             reply = {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}

@@ -216,7 +216,8 @@ class DashboardHttpServer:
         # Control-plane partition counters ride the same stream: GCS
         # redials, degraded-mode entries, and resync re-advertisements.
         for node_id, st in self.gcs.node_stats.items():
-            for name in ("objects_corrupted", "pull_retries",
+            for name in ("spilled_objects", "restored_objects",
+                         "objects_corrupted", "pull_retries",
                          "spill_fsync_ms", "gcs_reconnects",
                          "node_disconnects",
                          "resync_objects_readvertised",

@@ -12,6 +12,8 @@ fixed batch dim amortizes dispatch across concurrent requests.
 from __future__ import annotations
 
 import asyncio
+
+from ray_tpu._private.async_utils import spawn
 import functools
 from typing import Any, Callable, List, Optional
 
@@ -84,4 +86,4 @@ class _BatchQueue:
                     if not f.done():
                         f.set_exception(e)
 
-        asyncio.get_running_loop().create_task(run())
+        spawn(run(), name="serve-batch-run")

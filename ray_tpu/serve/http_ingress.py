@@ -36,6 +36,8 @@ chaos tests can trip the write path deterministically.
 from __future__ import annotations
 
 import asyncio
+
+from ray_tpu._private.async_utils import spawn
 import itertools
 import json
 import logging
@@ -104,7 +106,8 @@ class HTTPIngress:
         self._server = await asyncio.start_server(
             self._serve_conn, self._host, self._port)
         self._port = self._server.sockets[0].getsockname()[1]
-        asyncio.get_running_loop().create_task(self._route_refresh_loop())
+        self._route_refresh_task = spawn(
+            self._route_refresh_loop(), name="ingress-route-refresh")
 
     async def address(self) -> Tuple[str, int]:
         await self._ensure_started()

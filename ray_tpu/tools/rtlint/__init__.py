@@ -1,0 +1,19 @@
+"""rtlint — project-native static analysis for ray_tpu.
+
+Encodes the runtime's load-bearing invariants (no blocking calls on
+control-plane event loops, zero-pickle wire fast lane, no orphaned
+tasks, declared cross-thread state, jit purity, end-to-end metrics
+plumbing) as AST checks.  See docs/LINT.md for the rule catalog and
+the suppression/baseline workflow.
+
+Usage::
+
+    python -m ray_tpu.tools.rtlint ray_tpu/
+    python -m ray_tpu.tools.rtlint --format json --no-baseline ray_tpu/
+    python -m ray_tpu.tools.rtlint --write-baseline ray_tpu/
+"""
+
+from ray_tpu.tools.rtlint.engine import (Finding, LintConfig, LintResult,
+                                         lint_paths)
+
+__all__ = ["Finding", "LintConfig", "LintResult", "lint_paths"]

@@ -1,0 +1,100 @@
+"""CLI for rtlint.
+
+Exit codes: 0 clean (or all findings baselined), 1 actionable findings,
+2 usage / IO error.  The default baseline is ``.rtlint-baseline.json``
+next to the first path argument's parent (i.e. the repo root when run
+as ``python -m ray_tpu.tools.rtlint ray_tpu/`` from the checkout)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from ray_tpu.tools.rtlint.engine import (default_rules, lint_paths,
+                                         load_baseline, write_baseline)
+
+DEFAULT_BASELINE = ".rtlint-baseline.json"
+
+
+def _default_baseline_path(paths: List[str]) -> str:
+    if paths:
+        parent = os.path.dirname(os.path.abspath(paths[0].rstrip("/")))
+        return os.path.join(parent, DEFAULT_BASELINE)
+    return DEFAULT_BASELINE
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.rtlint",
+        description="ray_tpu project-native static analyzer")
+    ap.add_argument("paths", nargs="*", default=["ray_tpu"],
+                    help="files or directories to lint (default: ray_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         "next to the first path)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings as actionable")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline with all current findings "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(r.name)
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    paths = args.paths or ["ray_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or _default_baseline_path(paths)
+    baseline = set() if (args.no_baseline or args.write_baseline) \
+        else load_baseline(baseline_path)
+
+    result = lint_paths(paths, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in result.findings],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "files_checked": result.files_checked,
+            "errors": result.errors,
+        }, indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for err in result.errors:
+            print(f"error: {err}", file=sys.stderr)
+        n, b = len(result.findings), len(result.baselined)
+        print(f"rtlint: {result.files_checked} files, "
+              f"{n} finding(s), {b} baselined")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Rule 1 — blocking-in-loop.
+
+Every ``async def`` in this codebase runs on one of the control-plane
+event loops (GCS, raylet, core worker IO loop, daemon, serve replicas).
+A synchronous sleep, file/socket/subprocess call, or fsync inside one
+stalls every heartbeat, lease, and reply sharing that loop — the exact
+condition LoopWatchdog's ``loop_lag_ms`` counter flags at runtime.  This
+rule is the static counterpart: it walks each async function body
+(without descending into nested defs/lambdas, which are typically
+executor or thread targets) and flags known-blocking calls.
+
+It also expands one call level: a call to a *sync* method/function
+defined in the same file is scanned for the same blocking calls, and a
+hit is reported at the async call site ("via _collect_node_stats: ...").
+That catches the common pattern of an async loop delegating to a sync
+helper that quietly does file IO.
+
+In loop-critical modules (``config.loop_critical_suffixes``) the rule
+additionally flags ``cloudpickle.dumps/loads`` on the loop — closure and
+class pickling is unbounded work (plain ``pickle`` on bounded control
+frames is left to the wire-lane rule)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name, iter_body_calls)
+
+# exact dotted names that block the calling thread
+_BLOCKING = {
+    "time.sleep",
+    "os.fsync", "os.fdatasync", "os.sync", "os.system", "os.popen",
+    "os.wait", "os.waitpid",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "urllib.request.urlopen",
+    "shutil.copy", "shutil.copy2", "shutil.copyfile", "shutil.copytree",
+    "shutil.rmtree", "shutil.move",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+}
+_BLOCKING_PREFIXES = ("subprocess.",)
+_LOOP_SER = {"cloudpickle.dumps", "cloudpickle.loads", "cloudpickle.load",
+             "cloudpickle.dump"}
+
+
+def _blocking_reason(name: str, *, loop_critical: bool) -> Optional[str]:
+    if name == "open" or name.endswith(".open") and name in (
+            "io.open", "gzip.open", "bz2.open", "lzma.open"):
+        return "synchronous file IO (open) on the event loop"
+    if name in _BLOCKING:
+        return f"blocking call {name}() on the event loop"
+    if name.startswith(_BLOCKING_PREFIXES):
+        return f"synchronous subprocess call {name}() on the event loop"
+    if loop_critical and name in _LOOP_SER:
+        return (f"{name}() on a latency-critical loop "
+                "(closure/class pickling is unbounded work)")
+    return None
+
+
+def _sync_defs(unit: FileUnit) -> Dict[Tuple[str, str], ast.FunctionDef]:
+    """(class-or-'', name) -> sync FunctionDef, for one-level expansion."""
+    out: Dict[Tuple[str, str], ast.FunctionDef] = {}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.FunctionDef):
+            parent = unit.parents.get(node)
+            cls = parent.name if isinstance(parent, ast.ClassDef) else ""
+            out[(cls, node.name)] = node
+    return out
+
+
+class BlockingInLoop(Rule):
+    name = "blocking-in-loop"
+
+    def check(self, unit: FileUnit, config: LintConfig
+              ) -> Iterable[Finding]:
+        loop_critical = any(unit.path.endswith(sfx)
+                            for sfx in config.loop_critical_suffixes)
+        sync_defs = _sync_defs(unit)
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            cls_node = unit.parents.get(node)
+            cls = cls_node.name if isinstance(cls_node, ast.ClassDef) else ""
+            for call in iter_body_calls(node):
+                name = dotted_name(call.func)
+                if not name:
+                    continue
+                reason = _blocking_reason(name, loop_critical=loop_critical)
+                if reason is not None:
+                    yield self._finding(unit, call, reason)
+                    continue
+                # one-level expansion into same-file sync helpers
+                target = self._resolve_local(name, cls, sync_defs)
+                if target is None:
+                    continue
+                inner = self._first_blocking(target, loop_critical)
+                if inner is not None:
+                    yield self._finding(
+                        unit, call,
+                        f"calls {name}() which does {inner} "
+                        "(sync helper invoked from an async body)")
+
+    def _resolve_local(self, name: str, cls: str,
+                       sync_defs: Dict[Tuple[str, str], ast.FunctionDef]
+                       ) -> Optional[ast.FunctionDef]:
+        if name.startswith("self.") and name.count(".") == 1:
+            return sync_defs.get((cls, name.split(".", 1)[1]))
+        if "." not in name:
+            return sync_defs.get(("", name))
+        return None
+
+    def _first_blocking(self, fn: ast.FunctionDef, loop_critical: bool
+                        ) -> Optional[str]:
+        for call in iter_body_calls(fn):
+            name = dotted_name(call.func)
+            if not name:
+                continue
+            reason = _blocking_reason(name, loop_critical=loop_critical)
+            if reason is not None:
+                return f"{name}() [{fn.name}:{call.lineno}]"
+        return None
+
+    def _finding(self, unit: FileUnit, call: ast.Call, reason: str
+                 ) -> Finding:
+        return Finding(rule=self.name, path=unit.path, line=call.lineno,
+                       col=call.col_offset, message=reason,
+                       scope=unit.scope_of(call),
+                       source=unit.source_line(call.lineno),
+                       end_line=getattr(call, "end_lineno", 0) or 0)

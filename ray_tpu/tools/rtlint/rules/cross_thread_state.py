@@ -1,0 +1,148 @@
+"""Rule 4 — cross-thread-state.
+
+The core worker's threading model (see ``core_worker.py``'s module
+docstring) is two threads per process: the asyncio IO loop thread and
+the dedicated ``rt-exec`` execution thread, with ExecChannel as the
+only sanctioned handoff.  This rule encodes that contract per class:
+
+- **exec-side methods** are the targets of ``threading.Thread(target=
+  self.X)``, functions passed to ``.run(...)`` / ``run_in_executor(...)``
+  / ``.submit(...)``, and any ``def`` carrying a ``# rtlint: thread=exec``
+  annotation on its ``def`` line.
+- **loop-side methods** are the class's ``async def``s (plus anything
+  annotated ``# rtlint: thread=loop``).
+
+An attribute of ``self`` that is *written* (Store / AugAssign) on both
+sides is flagged unless every write sits under ``with self.<...lock...>``
+(any attribute whose name contains "lock").  Reads are not flagged —
+the runtime leans on the GIL for torn-read safety of references — and
+``__init__`` writes are construction-time (happens-before the thread
+starts) so they don't count as loop-side writes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name)
+
+_EXEC_SINKS = ("run", "run_in_executor", "submit", "call_soon_threadsafe")
+
+
+def _self_attr_writes(fn: ast.AST) -> List[Tuple[str, ast.AST, bool]]:
+    """(attr, node, locked) for each `self.x = ...` / `self.x += ...`
+    inside fn, without descending into nested defs.  `locked` is True
+    when the write sits under a `with self.<..lock..>:` block."""
+    out: List[Tuple[str, ast.AST, bool]] = []
+
+    def walk(node: ast.AST, locked: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            child_locked = locked
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    name = dotted_name(item.context_expr)
+                    if "lock" in name.lower() or "mutex" in name.lower():
+                        child_locked = True
+            targets: List[ast.AST] = []
+            if isinstance(child, ast.Assign):
+                targets = list(child.targets)
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                targets = [child.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.append((t.attr, child, child_locked))
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Attribute) and \
+                                isinstance(el.value, ast.Name) and \
+                                el.value.id == "self":
+                            out.append((el.attr, child, child_locked))
+            walk(child, child_locked)
+
+    walk(fn, False)
+    return out
+
+
+class CrossThreadState(Rule):
+    name = "cross-thread-state"
+
+    def check(self, unit: FileUnit, config: LintConfig
+              ) -> Iterable[Finding]:
+        for cls in ast.walk(unit.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            yield from self._check_class(unit, cls)
+
+    def _check_class(self, unit: FileUnit, cls: ast.ClassDef
+                     ) -> Iterable[Finding]:
+        methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        exec_side: Set[str] = set()
+        loop_side: Set[str] = set()
+
+        for name, fn in methods.items():
+            mark = unit.thread_marks.get(fn.lineno)
+            if mark == "exec":
+                exec_side.add(name)
+            elif mark == "loop":
+                loop_side.add(name)
+            elif isinstance(fn, ast.AsyncFunctionDef):
+                loop_side.add(name)
+
+        # discover exec-side methods from thread/executor handoffs
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        t = dotted_name(kw.value)
+                        if t.startswith("self."):
+                            exec_side.add(t.split(".", 1)[1])
+            elif leaf in _EXEC_SINKS:
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    t = dotted_name(arg)
+                    if t.startswith("self.") and t.count(".") == 1 and \
+                            t.split(".", 1)[1] in methods:
+                        exec_side.add(t.split(".", 1)[1])
+        if not exec_side or not loop_side:
+            return
+
+        writes: Dict[str, Dict[str, List[Tuple[ast.AST, bool]]]] = {}
+        for side, names in (("exec", exec_side), ("loop", loop_side)):
+            for mname in names:
+                fn = methods.get(mname)
+                if fn is None or mname == "__init__":
+                    continue
+                for attr, node, locked in _self_attr_writes(fn):
+                    writes.setdefault(attr, {}).setdefault(
+                        side, []).append((node, locked))
+
+        for attr, sides in sorted(writes.items()):
+            if "exec" not in sides or "loop" not in sides:
+                continue
+            unlocked = [(n, lk) for side in ("exec", "loop")
+                        for (n, lk) in sides[side] if not lk]
+            if not unlocked:
+                continue
+            node = unlocked[0][0]
+            yield Finding(
+                rule=self.name, path=unit.path, line=node.lineno,
+                col=node.col_offset,
+                message=(f"self.{attr} is written on both the loop thread "
+                         f"and the rt-exec thread in {cls.name} without a "
+                         "declared lock — guard every write with a "
+                         "`with self.<lock>:` block or hand off through "
+                         "ExecChannel"),
+                scope=unit.scope_of(node),
+                source=unit.source_line(node.lineno))

@@ -1,0 +1,138 @@
+"""Rule 6 — metrics-consistency (project-level).
+
+A counter is only real once it survives the whole observability chain:
+
+  raylet ``_collect_node_stats`` out-dict        (incremented + reported)
+    → GCS ``_FOLDED_COUNTERS`` dead-node folding (lifetime totals survive
+                                                  node death)
+    → ``util/state.py`` totals functions          (state API)
+    → ``dashboard/http_server.py`` ``/api/metrics`` Prometheus exposition
+
+PRs 2/3/9 each added counters and each had to wire all four stages by
+hand; a counter missing a stage silently under-reports (dead-node
+totals vanish) or never reaches dashboards.  This rule parses the four
+files (resolved via ``config.metrics_roles`` so tests can point at
+fixtures) and flags:
+
+- a node-stats counter (dict key whose value reads a ``self._*``
+  attribute, directly or through ``round(...)``) absent from
+  ``_FOLDED_COUNTERS``;
+- a node-stats counter absent from ``util/state.py``'s string constants;
+- a node-stats counter absent from the HTTP server's string constants;
+- a folded counter that no consumer mentions at all (stale fold entry).
+
+It only activates when every role file is present in the lint run —
+single-file invocations skip it."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name)
+
+
+def _string_constants(unit: FileUnit) -> Set[str]:
+    return {n.value for n in ast.walk(unit.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def _counter_value(v: ast.AST) -> bool:
+    """True when a dict value reads a private self attribute — the shape
+    of a lifetime counter ('spilled_objects': self._spilled_objects or
+    'spill_fsync_ms': round(self._spill_fsync_ms, 3))."""
+    if isinstance(v, ast.Call) and dotted_name(v.func) == "round" and v.args:
+        v = v.args[0]
+    return (isinstance(v, ast.Attribute) and
+            isinstance(v.value, ast.Name) and v.value.id == "self" and
+            v.attr.startswith("_"))
+
+
+def _node_stat_counters(unit: FileUnit, config: LintConfig
+                        ) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.FunctionDef) or \
+                node.name != "_collect_node_stats":
+            continue
+        for d in ast.walk(node):
+            if not isinstance(d, ast.Dict):
+                continue
+            for k, v in zip(d.keys, d.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and \
+                        k.value not in config.metrics_ignore and \
+                        _counter_value(v):
+                    out.append((k.value, k.lineno))
+    return out
+
+
+def _folded_counters(unit: FileUnit) -> Tuple[Set[str], int]:
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "_FOLDED_COUNTERS" in names and \
+                    isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                vals = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+                return vals, node.lineno
+    return set(), 0
+
+
+class MetricsConsistency(Rule):
+    name = "metrics-consistency"
+
+    def check_project(self, units: List[FileUnit], config: LintConfig
+                      ) -> Iterable[Finding]:
+        roles: Dict[str, Optional[FileUnit]] = {}
+        for role, sfx in config.metrics_roles.items():
+            roles[role] = next(
+                (u for u in units if u.path.endswith(sfx)), None)
+        if any(u is None for u in roles.values()):
+            return  # partial lint run — chain can't be checked
+
+        src = roles["node_stats"]
+        fold_unit = roles["fold"]
+        counters = _node_stat_counters(src, config)
+        folded, fold_line = _folded_counters(fold_unit)
+        state_strings = _string_constants(roles["state"])
+        http_strings = _string_constants(roles["http"])
+
+        for name, line in counters:
+            if name not in folded:
+                yield Finding(
+                    rule=self.name, path=src.path, line=line, col=0,
+                    message=(f"counter '{name}' reported in node stats but "
+                             f"missing from _FOLDED_COUNTERS in "
+                             f"{fold_unit.path} — lifetime total is lost "
+                             "when the node dies"),
+                    scope="_collect_node_stats", source=name)
+            if name not in state_strings:
+                yield Finding(
+                    rule=self.name, path=src.path, line=line, col=0,
+                    message=(f"counter '{name}' reported in node stats but "
+                             f"absent from {roles['state'].path} — no "
+                             "state-API totals include it"),
+                    scope="_collect_node_stats", source=name + ":state")
+            if name not in http_strings:
+                yield Finding(
+                    rule=self.name, path=src.path, line=line, col=0,
+                    message=(f"counter '{name}' reported in node stats but "
+                             f"absent from {roles['http'].path} — it never "
+                             "reaches /api/metrics"),
+                    scope="_collect_node_stats", source=name + ":http")
+
+        counter_names = {c for c, _ in counters}
+        for name in sorted(folded):
+            if name in counter_names:
+                continue
+            if name not in state_strings and name not in http_strings:
+                yield Finding(
+                    rule=self.name, path=fold_unit.path, line=fold_line,
+                    col=0,
+                    message=(f"folded counter '{name}' is consumed nowhere "
+                             "(not in node stats, state totals, or the "
+                             "metrics endpoint) — stale fold entry"),
+                    scope="_FOLDED_COUNTERS", source=name)

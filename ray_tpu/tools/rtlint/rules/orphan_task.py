@@ -1,0 +1,89 @@
+"""Rule 3 — orphan-task (unawaited coroutines + fire-and-forget tasks).
+
+Two failure shapes, one rule:
+
+1. **Unawaited coroutine**: a bare expression statement calling an
+   ``async def`` defined in the same file.  The coroutine object is
+   created and dropped — the body never runs.  Python warns at runtime
+   ("coroutine was never awaited") but only on paths that execute.
+
+2. **Orphan create_task**: ``loop.create_task(...)`` /
+   ``asyncio.ensure_future(...)`` as a bare statement.  The task runs,
+   but if it raises, the exception sits on an unreferenced Task object
+   and surfaces (if ever) as a destructor warning long after the
+   causal context is gone — the classic silent-failure mode of every
+   fire-and-forget dispatch loop in this runtime.
+
+Accepted patterns (not flagged):
+- the result is assigned / appended / passed as an argument (tracked),
+- ``.add_done_callback(...)`` chained directly on the call,
+- a spawn helper from ``config.spawn_helpers`` (e.g.
+  ``ray_tpu._private.async_utils.spawn``) which attaches the shared
+  exception-logging done callback itself."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name)
+
+_SPAWN_ATTRS = ("create_task", "ensure_future")
+
+
+def _async_def_names(unit: FileUnit) -> Set[str]:
+    return {n.name for n in ast.walk(unit.tree)
+            if isinstance(n, ast.AsyncFunctionDef)}
+
+
+class OrphanTask(Rule):
+    name = "orphan-task"
+
+    def check(self, unit: FileUnit, config: LintConfig
+              ) -> Iterable[Finding]:
+        async_names = _async_def_names(unit)
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            # leaf from the Attribute/Name directly so chained receivers
+            # (`asyncio.get_event_loop().create_task(...)`) still resolve
+            if isinstance(call.func, ast.Attribute):
+                leaf = call.func.attr
+            elif isinstance(call.func, ast.Name):
+                leaf = call.func.id
+            else:
+                continue
+            name = dotted_name(call.func) or leaf
+
+            # shape 1: bare call of a same-file async def
+            if leaf in async_names and leaf not in config.spawn_helpers \
+                    and not name.startswith("asyncio."):
+                # `self.foo()` / `foo()` where foo is async → never runs
+                if name in (leaf, f"self.{leaf}"):
+                    yield Finding(
+                        rule=self.name, path=unit.path, line=call.lineno,
+                        col=call.col_offset,
+                        message=(f"coroutine {name}() is never awaited — "
+                                 "the body will not run (await it, or "
+                                 "spawn() it as a task)"),
+                        scope=unit.scope_of(call),
+                        source=unit.source_line(call.lineno),
+                        end_line=getattr(call, "end_lineno", 0) or 0)
+                continue
+
+            # shape 2: bare create_task / ensure_future
+            if leaf in _SPAWN_ATTRS or name == "asyncio.ensure_future":
+                yield Finding(
+                    rule=self.name, path=unit.path, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"{leaf}() result dropped — task exceptions "
+                             "will be swallowed; use async_utils.spawn() "
+                             "(attaches the exception-logging done "
+                             "callback) or keep a reference"),
+                    scope=unit.scope_of(call),
+                    source=unit.source_line(call.lineno),
+                    end_line=getattr(call, "end_lineno", 0) or 0)

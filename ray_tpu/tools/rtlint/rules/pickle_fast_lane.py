@@ -1,0 +1,64 @@
+"""Rule 2 — pickle-on-fast-lane.
+
+PR 8's wire-speed task plane holds a hard invariant: the v2 binary
+fast path (``_flush_outbox_v2`` framing, ``fast_handler`` dispatch,
+``fast_actor_call`` / ``_fast_reply`` in the worker, and the core
+worker's ``resolve_args_fast`` / ``pack_return_sync`` pair) never
+touches pickle — primitives and bytes ride the native T_* codec, and
+anything else must take the counted fallback through ``wire.stats``.
+A pickle call creeping into one of these functions silently re-adds
+the ~44µs/call/side cost the whole refactor removed, without tripping
+any runtime counter (the fallback counters only see the *codec's*
+escape hatch, not an ad-hoc ``pickle.dumps``).
+
+The rule is config-driven: ``config.fast_lane`` maps a path suffix to a
+regex over function names; any pickle/cloudpickle/marshal call inside a
+matching function is flagged.  ``wire.py`` itself is deliberately
+absent from the default config — its pickle fallback is the designed,
+counted escape hatch."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name, iter_body_calls)
+
+_PICKLE_MODULES = ("pickle.", "cloudpickle.", "marshal.", "_pickle.")
+
+
+class PickleFastLane(Rule):
+    name = "pickle-fast-lane"
+
+    def check(self, unit: FileUnit, config: LintConfig
+              ) -> Iterable[Finding]:
+        pattern = None
+        for sfx, rx in config.fast_lane.items():
+            if unit.path.endswith(sfx):
+                pattern = re.compile(rx)
+                break
+        if pattern is None:
+            return
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not pattern.search(node.name):
+                continue
+            # nested defs inside a fast-lane function run on the same
+            # path (done-callbacks, closures) — descend into them.
+            for call in iter_body_calls(node, into_nested=True):
+                name = dotted_name(call.func)
+                if name.startswith(_PICKLE_MODULES):
+                    yield Finding(
+                        rule=self.name, path=unit.path, line=call.lineno,
+                        col=call.col_offset,
+                        message=(f"{name}() inside fast-lane function "
+                                 f"{node.name}() — the v2 wire path is "
+                                 "zero-pickle by contract; use the T_* "
+                                 "codec or route through the counted "
+                                 "fallback"),
+                        scope=unit.scope_of(call),
+                        source=unit.source_line(call.lineno),
+                        end_line=getattr(call, "end_lineno", 0) or 0)

@@ -7,6 +7,8 @@ spawning SpecificServers, health-checked, reaped on disconnect).
 from __future__ import annotations
 
 import asyncio
+
+from ray_tpu._private.async_utils import spawn
 import logging
 import os
 import secrets
@@ -87,13 +89,16 @@ class ClientProxyServer:
             return {"ok": True, "session_address": sess.address,
                     "token": sess.token, "reconnected": True}
         token = secrets.token_hex(16)
-        proc = subprocess.Popen(
+        # fork+exec blocks for milliseconds — run it on the executor so a
+        # session spawn never stalls other clients' RPCs on this loop.
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.util.client.session"],
             env={**os.environ,
                  "RT_CLIENT_SESSION_GCS": self.head_address,
                  "RT_CLIENT_SESSION_GRACE_S": str(self.grace_s),
                  "RT_CLIENT_SESSION_ID": client_id},
-            stdout=subprocess.PIPE, text=True)
+            stdout=subprocess.PIPE, text=True))
         loop = asyncio.get_running_loop()
         try:
             line = await asyncio.wait_for(
@@ -158,7 +163,7 @@ def start_proxy(head_address: str, port: int = 0, **kwargs):
             await proxy.start(port)
             holder["address"] = proxy.address
             started.set()
-        loop.create_task(boot())
+        spawn(boot(), name="client-proxy-boot", loop=loop)
         loop.run_forever()
 
     t = threading.Thread(target=main, daemon=True, name="client-proxy")

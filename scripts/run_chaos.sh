@@ -44,6 +44,15 @@ fi
 RUNS="${CHAOS_RUNS:-3}"
 BURNERS="${CHAOS_BURNERS:-$((2 * $(nproc)))}"
 
+# Preflight: the static invariants the chaos suite stresses dynamically
+# (no blocking calls on control-plane loops, no orphaned tasks, ...)
+# must hold before we burn CPU-hours proving them under load.
+echo "chaos gate: rtlint preflight"
+if ! env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint ray_tpu/; then
+    echo "chaos gate: FAIL (rtlint preflight — fix or baseline first)"
+    exit 1
+fi
+
 echo "chaos gate [${PROFILE}]: ${RUNS} runs, ${BURNERS} nice'd CPU burners"
 
 burner_pids=()

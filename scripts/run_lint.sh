@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# rtlint gate: project-native static analysis over ray_tpu/.
+# Exit 0 = clean (baselined findings are reported but don't fail).
+#
+#   scripts/run_lint.sh             # human output
+#   scripts/run_lint.sh --json      # machine output
+#   scripts/run_lint.sh --update    # rewrite the baseline (after review!)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+case "${1:-}" in
+  --json)
+    exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint \
+        --format json ray_tpu/ ;;
+  --update)
+    exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint \
+        --write-baseline ray_tpu/ ;;
+  *)
+    exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint ray_tpu/ ;;
+esac

@@ -1,0 +1,491 @@
+"""rtlint static-analyzer tests: per-rule positive/negative fixtures,
+suppression + baseline semantics, CLI smoke, and the repo-clean gate."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.rtlint import LintConfig, lint_paths
+from ray_tpu.tools.rtlint.engine import load_baseline, write_baseline
+
+pytestmark = pytest.mark.lint
+
+
+def _write(root, rel, src):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return path
+
+
+def _lint(root, **kw):
+    return lint_paths([str(root)], **kw)
+
+
+def _rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+# ------------------------------------------------------ blocking-in-loop
+
+def test_blocking_in_loop_positive(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import time
+        async def loop_body():
+            time.sleep(1)
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["blocking-in-loop"]
+    assert "time.sleep" in res.findings[0].message
+
+
+def test_blocking_in_loop_open_and_subprocess(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import subprocess
+        async def h():
+            with open("/tmp/x") as f:
+                f.read()
+            subprocess.run(["true"])
+    """)
+    res = _lint(tmp_path / "proj")
+    assert len(res.findings) == 2
+    assert all(f.rule == "blocking-in-loop" for f in res.findings)
+
+
+def test_blocking_in_loop_negative_nested_and_await(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import asyncio, time
+        async def h():
+            def executor_target():
+                time.sleep(1)          # runs on the executor, fine
+            await asyncio.sleep(0.1)   # async sleep, fine
+            await asyncio.get_running_loop().run_in_executor(
+                None, executor_target)
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_blocking_in_loop_sync_helper_expansion(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        class A:
+            def _helper(self):
+                with open("/tmp/x") as f:
+                    return f.read()
+            async def h(self):
+                return self._helper()
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["blocking-in-loop"]
+    assert "_helper" in res.findings[0].message
+
+
+def test_blocking_in_loop_cloudpickle_only_on_loop_modules(tmp_path):
+    src = """
+        import cloudpickle
+        async def h(msg):
+            return cloudpickle.loads(msg)
+    """
+    _write(tmp_path / "proj", "elsewhere.py", src)
+    _write(tmp_path / "proj", "_private/gcs.py", src)
+    res = _lint(tmp_path / "proj")
+    assert [f.path for f in res.findings] == ["proj/_private/gcs.py"]
+
+
+# ---------------------------------------------------- pickle-fast-lane
+
+def test_pickle_fast_lane_positive(tmp_path):
+    _write(tmp_path / "proj", "_private/protocol.py", """
+        import pickle
+        class Conn:
+            def _flush_outbox_v2(self):
+                return pickle.dumps({"x": 1})
+    """)
+    res = _lint(tmp_path / "proj")
+    assert "pickle-fast-lane" in _rules_hit(res)
+
+
+def test_pickle_fast_lane_ignores_slow_path(tmp_path):
+    _write(tmp_path / "proj", "_private/protocol.py", """
+        import pickle
+        class Conn:
+            def _flush_outbox(self):     # legacy v1 path — allowed
+                return pickle.dumps({"x": 1})
+    """)
+    assert "pickle-fast-lane" not in _rules_hit(_lint(tmp_path / "proj"))
+
+
+def test_pickle_fast_lane_sees_nested_defs(tmp_path):
+    _write(tmp_path / "proj", "_private/worker_main.py", """
+        import pickle
+        class T:
+            def fast_actor_call(self, msg):
+                def done(fut):
+                    return pickle.dumps(fut.result())
+                return done
+    """)
+    assert "pickle-fast-lane" in _rules_hit(_lint(tmp_path / "proj"))
+
+
+# --------------------------------------------------------- orphan-task
+
+def test_orphan_create_task_positive(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import asyncio
+        async def h():
+            asyncio.get_running_loop().create_task(work())
+        async def work():
+            pass
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["orphan-task"]
+
+
+def test_orphan_task_tracked_is_clean(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import asyncio
+        async def work():
+            pass
+        async def h():
+            t = asyncio.get_running_loop().create_task(work())
+            return t
+        async def h2(tasks):
+            tasks.append(asyncio.ensure_future(work()))
+        async def h3():
+            asyncio.get_running_loop().create_task(
+                work()).add_done_callback(print)
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_orphan_spawn_helper_is_clean(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        from ray_tpu._private.async_utils import spawn
+        async def work():
+            pass
+        async def h():
+            spawn(work(), name="w")
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_unawaited_coroutine_positive(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        async def work():
+            pass
+        async def h():
+            work()          # missing await: never runs
+        async def ok():
+            await work()
+    """)
+    res = _lint(tmp_path / "proj")
+    assert len(res.findings) == 1
+    assert "never awaited" in res.findings[0].message
+
+
+# --------------------------------------------------- cross-thread-state
+
+_CROSS_SRC = """
+    import threading
+    class C:
+        def __init__(self):
+            self.n = 0
+            self.lock = threading.Lock()
+            threading.Thread(target=self._worker).start()
+        def _worker(self):
+            {exec_write}
+        async def on_loop(self):
+            {loop_write}
+"""
+
+
+def test_cross_thread_unlocked_write_flagged(tmp_path):
+    _write(tmp_path / "proj", "a.py", _CROSS_SRC.format(
+        exec_write="self.n += 1", loop_write="self.n = 0"))
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["cross-thread-state"]
+    assert "self.n" in res.findings[0].message
+
+
+def test_cross_thread_locked_write_clean(tmp_path):
+    _write(tmp_path / "proj", "a.py", _CROSS_SRC.format(
+        exec_write="\n".join(["with self.lock:",
+                              "                self.n += 1"]),
+        loop_write="\n".join(["with self.lock:",
+                              "                self.n = 0"])))
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_cross_thread_one_side_only_clean(tmp_path):
+    _write(tmp_path / "proj", "a.py", _CROSS_SRC.format(
+        exec_write="self.exec_only = 1", loop_write="self.loop_only = 2"))
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_cross_thread_annotation_marks_exec_side(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        class C:
+            def pumped_externally(self):  # rtlint: thread=exec
+                self.shared = 1
+            async def on_loop(self):
+                self.shared = 2
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["cross-thread-state"]
+
+
+# ----------------------------------------------------------- jit-purity
+
+def test_jit_purity_decorator_print(tmp_path):
+    _write(tmp_path / "proj", "ops/k.py", """
+        import jax
+        @jax.jit
+        def f(x):
+            print("tracing", x)
+            return x + 1
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["jit-purity"]
+    assert "print" in res.findings[0].message
+
+
+def test_jit_purity_call_form_closure(tmp_path):
+    _write(tmp_path / "proj", "models/m.py", """
+        import jax, time
+        def make_step():
+            def step(x):
+                t0 = time.time()
+                return x * t0
+            return jax.jit(step, donate_argnums=(0,))
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["jit-purity"]
+    assert "time.time" in res.findings[0].message
+
+
+def test_jit_purity_outside_scope_dirs_ignored(tmp_path):
+    _write(tmp_path / "proj", "scripts/s.py", """
+        import jax
+        @jax.jit
+        def f(x):
+            print(x)
+            return x
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_jit_purity_clean_kernel(tmp_path):
+    _write(tmp_path / "proj", "ops/k.py", """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            jax.debug.print("x={x}", x=x)
+            key = jax.random.PRNGKey(0)
+            return x + jax.random.normal(key, x.shape)
+        def unjitted(x):
+            print(x)   # not traced — fine
+            return x
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_jit_purity_mutable_static_default(tmp_path):
+    _write(tmp_path / "proj", "autotune/a.py", """
+        import jax
+        @jax.jit
+        def f(x, cfg=[1, 2]):
+            return x
+    """)
+    res = _lint(tmp_path / "proj")
+    assert [f.rule for f in res.findings] == ["jit-purity"]
+    assert "hashable" in res.findings[0].message
+
+
+# -------------------------------------------------- metrics-consistency
+
+_RAYLET_T = """
+    class Raylet:
+        def _collect_node_stats(self, prev):
+            return {{
+                "timestamp": 0,
+                "workers": [],
+                {entries}
+            }}
+"""
+_GCS_T = "_FOLDED_COUNTERS = ({folded})\n"
+_STATE_T = "KEYS = ({keys})\n"
+_HTTP_T = "NAMES = ({names})\n"
+
+
+def _metrics_tree(tmp_path, *, entries, folded, state, http):
+    root = tmp_path / "proj"
+    _write(root, "_private/raylet.py", _RAYLET_T.format(entries=entries))
+    _write(root, "_private/gcs.py", _GCS_T.format(folded=folded))
+    _write(root, "util/state.py", _STATE_T.format(keys=state))
+    _write(root, "dashboard/http_server.py", _HTTP_T.format(names=http))
+    return root
+
+
+def test_metrics_chain_complete_is_clean(tmp_path):
+    root = _metrics_tree(
+        tmp_path,
+        entries='"spilled": self._spilled,',
+        folded='"spilled",', state='"spilled",', http='"spilled",')
+    assert _lint(root).findings == []
+
+
+def test_metrics_missing_stage_flagged(tmp_path):
+    root = _metrics_tree(
+        tmp_path,
+        entries='"spilled": self._spilled,',
+        folded='"spilled",', state='"spilled",', http='"other",')
+    res = _lint(root)
+    assert [f.rule for f in res.findings] == ["metrics-consistency"]
+    assert "/api/metrics" in res.findings[0].message
+
+
+def test_metrics_stale_fold_entry_flagged(tmp_path):
+    root = _metrics_tree(
+        tmp_path,
+        entries='"spilled": self._spilled,',
+        folded='"spilled", "ghost",', state='"spilled",',
+        http='"spilled",')
+    res = _lint(root)
+    assert len(res.findings) == 1
+    assert "ghost" in res.findings[0].message
+
+
+def test_metrics_skips_partial_lint_runs(tmp_path):
+    # only the raylet present: the chain can't be checked, no findings
+    _write(tmp_path / "proj", "_private/raylet.py",
+           _RAYLET_T.format(entries='"spilled": self._spilled,'))
+    assert _lint(tmp_path / "proj").findings == []
+
+
+# ----------------------------------------- suppressions, baseline, CLI
+
+def test_inline_suppression(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import time
+        async def h():
+            time.sleep(1)  # rtlint: disable=blocking-in-loop
+        async def h2():
+            time.sleep(1)  # rtlint: disable
+        async def h3():
+            time.sleep(1)  # still flagged
+    """)
+    res = _lint(tmp_path / "proj")
+    assert len(res.findings) == 1
+    assert res.findings[0].scope == "h3"
+
+
+def test_suppression_spans_multiline_statement(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import asyncio
+        async def work():
+            pass
+        async def h():
+            asyncio.get_running_loop().create_task(
+                work())  # rtlint: disable=orphan-task
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_file_level_suppression(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        # rtlint: disable-file=blocking-in-loop
+        import time
+        async def h():
+            time.sleep(1)
+    """)
+    assert _lint(tmp_path / "proj").findings == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    _write(tmp_path / "proj", "a.py", """
+        import time
+        async def h():
+            time.sleep(1)
+    """)
+    res = _lint(tmp_path / "proj")
+    assert len(res.findings) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), res.findings)
+    res2 = _lint(tmp_path / "proj", baseline=load_baseline(str(bl)))
+    assert res2.findings == []
+    assert len(res2.baselined) == 1
+    # a NEW finding is still actionable under the old baseline
+    _write(tmp_path / "proj", "b.py", """
+        import time
+        async def g():
+            time.sleep(2)
+    """)
+    res3 = _lint(tmp_path / "proj", baseline=load_baseline(str(bl)))
+    assert len(res3.findings) == 1
+    assert res3.findings[0].path == "proj/b.py"
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    src = """
+        import time
+        async def h():
+            time.sleep(1)
+    """
+    _write(tmp_path / "proj", "a.py", src)
+    fp1 = _lint(tmp_path / "proj").findings[0].fingerprint
+    _write(tmp_path / "proj", "a.py", "# a new leading comment\n"
+           + textwrap.dedent(src))
+    fp2 = _lint(tmp_path / "proj").findings[0].fingerprint
+    assert fp1 == fp2
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    from ray_tpu.tools.rtlint.__main__ import main
+    _write(tmp_path / "proj", "a.py", """
+        import time
+        async def h():
+            time.sleep(1)
+    """)
+    rc = main(["--format", "json", "--no-baseline",
+               str(tmp_path / "proj")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"][0]["rule"] == "blocking-in-loop"
+    # write-baseline then rerun: clean exit
+    rc = main(["--write-baseline", str(tmp_path / "proj")])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main([str(tmp_path / "proj")])
+    assert rc == 0
+    assert main(["--list-rules"]) == 0
+    assert main([str(tmp_path / "missing")]) == 2
+    assert main(["--rules", "bogus", str(tmp_path / "proj")]) == 2
+
+
+def test_rule_filter(tmp_path):
+    from ray_tpu.tools.rtlint.__main__ import main
+    _write(tmp_path / "proj", "a.py", """
+        import time
+        async def h():
+            time.sleep(1)
+    """)
+    assert main(["--rules", "orphan-task", "--no-baseline",
+                 str(tmp_path / "proj")]) == 0
+
+
+# ------------------------------------------------------- repo-clean gate
+
+def test_repo_is_rtlint_clean():
+    """The gate the CI preflight relies on: rtlint over the real ray_tpu/
+    tree reports zero non-baselined findings with ≥6 active rules."""
+    from ray_tpu.tools.rtlint.engine import default_rules
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "ray_tpu")
+    baseline = load_baseline(os.path.join(repo, ".rtlint-baseline.json"))
+    assert len(default_rules()) >= 6
+    res = lint_paths([pkg], baseline=baseline)
+    assert res.errors == []
+    msgs = [f.render() for f in res.findings]
+    assert msgs == [], "rtlint found new issues:\n" + "\n".join(msgs)
