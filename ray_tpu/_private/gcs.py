@@ -443,7 +443,9 @@ class GcsServer:
                         "spill_fsync_ms", "gcs_reconnects",
                         "node_disconnects", "resync_objects_readvertised",
                         "autotune_cache_hits", "autotune_cache_misses",
-                        "autotune_tune_ms")
+                        "autotune_tune_ms",
+                        "router_retries", "circuit_open",
+                        "streams_resumed", "drain_handoffs")
 
     def dead_spill_totals(self) -> Dict[str, int]:
         """Aggregate spill/restore/integrity counters folded from dead

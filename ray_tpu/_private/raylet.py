@@ -505,6 +505,15 @@ class Raylet:
             out.update(_autotune_metrics.stats())
         except Exception:
             pass
+        try:
+            # Serve resilience counters (router retries, circuit-breaker
+            # ejections, mid-stream failovers, drain handoffs) for THIS
+            # process; the ingress/controller/handle worker processes
+            # reach the dashboard via util.metrics aggregation instead.
+            from ray_tpu.serve import metrics as _serve_metrics
+            out.update(_serve_metrics.stats())
+        except Exception:
+            pass
         # loop_lag_ms is merged by the caller on the loop thread —
         # LoopWatchdog.record() mutates watchdog state.
         return out

@@ -222,7 +222,9 @@ class DashboardHttpServer:
                          "node_disconnects",
                          "resync_objects_readvertised",
                          "autotune_cache_hits", "autotune_cache_misses",
-                         "autotune_tune_ms"):
+                         "autotune_tune_ms",
+                         "router_retries", "circuit_open",
+                         "streams_resumed", "drain_handoffs"):
                 if name in st:
                     lag_records.append({
                         "name": name, "type": "counter",
@@ -238,17 +240,21 @@ class DashboardHttpServer:
         # raw records would emit duplicate series and drop histogram
         # buckets, and any per-endpoint renaming would give one metric two
         # series names depending on scrape point.
-        # Autotune counters flow through the user-metrics pipe (worker
-        # processes flush them like any Counter) but are SYSTEM series:
-        # split them out under the ray_tpu_ prefix so operators find
-        # cache hit rate and cold-tune cost next to the other health
+        # Autotune and serve-resilience counters flow through the
+        # user-metrics pipe (worker processes flush them like any
+        # Counter) but are SYSTEM series: split them out under the
+        # ray_tpu_ prefix so operators find cache hit rate, failover
+        # counts, and circuit-breaker ejections next to the other health
         # series, not namespaced as user metrics.
+        _SERVE_COUNTERS = ("router_retries", "circuit_open",
+                           "streams_resumed", "drain_handoffs")
         agg = self.gcs.aggregated_metrics()
-        autotune = [m for m in agg
-                    if str(m.get("name", "")).startswith("autotune_")]
-        user = [m for m in agg if m not in autotune]
+        system = [m for m in agg
+                  if str(m.get("name", "")).startswith("autotune_")
+                  or str(m.get("name", "")) in _SERVE_COUNTERS]
+        user = [m for m in agg if m not in system]
         return "\n".join(lines) + "\n" + \
-            render_prometheus(lag_records + autotune, prefix="ray_tpu_") + \
+            render_prometheus(lag_records + system, prefix="ray_tpu_") + \
             render_prometheus(user)
 
 

@@ -25,7 +25,7 @@ from ray_tpu.serve.controller import (CONTROLLER_NAME, ServeController,
 from ray_tpu.serve.router import DeploymentHandle
 
 __all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
-           "batch", "status", "start_http"]
+           "batch", "status", "start_http", "rolling_restart"]
 
 
 class Deployment:
@@ -171,6 +171,16 @@ def status() -> Dict[str, Any]:
 
 def delete(name: str):
     ray_tpu.get(_controller().delete_deployment.remote(name))
+
+
+def rolling_restart(name: str) -> Dict[str, Any]:
+    """Replace every replica of ``name`` one at a time with zero dropped
+    streams: surge-create the replacement, stop routing to the victim
+    (long-poll push), drain it (RT_SERVE_DRAIN_S), then kill it —
+    stragglers complete via the ingress's mid-stream failover.  Returns
+    ``{"deployment", "replaced", "skipped"}``."""
+    return ray_tpu.get(_controller().rolling_restart.remote(name),
+                       timeout=600)
 
 
 def start_http(host: str = "127.0.0.1", port: int = 0,

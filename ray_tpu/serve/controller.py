@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -24,6 +25,13 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_serve_controller"
 RECONCILE_PERIOD_S = 0.5
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass
@@ -76,37 +84,65 @@ class Replica:
             fn = fn.__call__
         return fn
 
-    async def handle_request(self, args, kwargs, method: Optional[str] = None):
+    async def handle_request(self, args, kwargs,
+                             method: Optional[str] = None,
+                             deadline: Optional[float] = None):
         import functools
+
+        from ray_tpu.serve import resilience
+
+        async def _invoke():
+            fn = self._resolve(
+                self._fn if method is None else getattr(self._fn, method))
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                # Sync handlers must not block the replica's event loop:
+                # run them on threads; self._sem bounds the fan-out.
+                result = \
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, functools.partial(fn, *args, **kwargs))
+                if asyncio.iscoroutine(result):
+                    result = await result
+            # A generator-handler called through the unary path drains
+            # to a list — the raw generator object is replica-local
+            # and would fail to pickle into the reply.
+            if hasattr(result, "__anext__"):
+                return [item async for item in result]
+            if hasattr(result, "__next__") and hasattr(result, "send"):
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, list, result)
+            return result
+
         self._outstanding += 1
+        # Publish the end-to-end deadline to the handler body (the
+        # inference engine reads it to bound decode); the wait_for below
+        # is the backstop for handlers that never look.
+        token = resilience.set_deadline(deadline)
         try:
+            rem = resilience.deadline_remaining(deadline)
+            if rem is not None and rem <= 0:
+                raise resilience.DeadlineExceeded(
+                    "deadline expired before the replica started")
             async with self._sem:
-                fn = self._resolve(
-                    self._fn if method is None else getattr(self._fn, method))
-                if asyncio.iscoroutinefunction(fn):
-                    result = await fn(*args, **kwargs)
-                else:
-                    # Sync handlers must not block the replica's event loop:
-                    # run them on threads; self._sem bounds the fan-out.
-                    result = \
-                        await asyncio.get_running_loop().run_in_executor(
-                            None, functools.partial(fn, *args, **kwargs))
-                    if asyncio.iscoroutine(result):
-                        result = await result
-                # A generator-handler called through the unary path drains
-                # to a list — the raw generator object is replica-local
-                # and would fail to pickle into the reply.
-                if hasattr(result, "__anext__"):
-                    return [item async for item in result]
-                if hasattr(result, "__next__") and hasattr(result, "send"):
-                    return await asyncio.get_running_loop().run_in_executor(
-                        None, list, result)
-                return result
+                rem = resilience.deadline_remaining(deadline)
+                if rem is None:
+                    return await _invoke()
+                if rem <= 0:
+                    raise resilience.DeadlineExceeded(
+                        "deadline expired while queued on the replica")
+                try:
+                    return await asyncio.wait_for(_invoke(), rem)
+                except asyncio.TimeoutError:
+                    raise resilience.DeadlineExceeded(
+                        "deadline expired during the request") from None
         finally:
+            resilience.reset_deadline(token)
             self._outstanding -= 1
 
     async def handle_stream(self, args, kwargs,
-                            method: Optional[str] = None):
+                            method: Optional[str] = None,
+                            deadline: Optional[float] = None):
         """Streaming twin of handle_request: an async generator the owner
         consumes per-item via ``num_returns="streaming"`` — the caller
         sees each yield while the handler is still running.  Sync
@@ -114,13 +150,26 @@ class Replica:
         (non-generator) results degrade to a single-item stream.
         ``_outstanding``/the semaphore span the WHOLE stream life, so
         queue_len (the autoscaler signal) counts live streams, not just
-        call setup."""
+        call setup.  The request ``deadline`` is published through
+        ``resilience.set_deadline`` for the handler (the engine bounds
+        decode with it) and re-checked here at every yield."""
         import functools
 
+        from ray_tpu.serve import resilience
         from ray_tpu.util import fault_injection
+
+        def _check_deadline():
+            rem = resilience.deadline_remaining(deadline)
+            if rem is not None and rem <= 0:
+                raise resilience.DeadlineExceeded(
+                    "deadline expired mid-stream")
+
         self._outstanding += 1
+        token = resilience.set_deadline(deadline)
         try:
+            _check_deadline()
             async with self._sem:
+                _check_deadline()
                 fn = self._resolve(
                     self._fn if method is None else getattr(self._fn, method))
                 loop = asyncio.get_running_loop()
@@ -135,6 +184,7 @@ class Replica:
                         stall = fault_injection.stall_stream_s()
                         if stall:
                             await asyncio.sleep(stall)
+                        _check_deadline()
                         yield item
                 elif hasattr(result, "__next__") and hasattr(result, "send"):
                     sentinel = object()
@@ -147,6 +197,7 @@ class Replica:
                             stall = fault_injection.stall_stream_s()
                             if stall:
                                 await asyncio.sleep(stall)
+                            _check_deadline()
                             yield item
                     finally:
                         close = getattr(result, "close", None)
@@ -155,6 +206,7 @@ class Replica:
                 else:
                     yield result
         finally:
+            resilience.reset_deadline(token)
             self._outstanding -= 1
 
     def reconfigure(self, user_config: Dict[str, Any]) -> bool:
@@ -304,22 +356,40 @@ class ServeController:
         await self._reconcile_once()
         return True
 
-    async def _kill_replica(self, handle, drain_s: float = 10.0):
+    async def _kill_replica(self, handle,
+                            drain_s: Optional[float] = None):
         """Drain then kill (reference: replica graceful shutdown —
         deployment_state waits for in-flight requests before stopping).
-        Bounded: a wedged request must not block scale-down forever.
-        Async kill: the blocking ray_tpu.kill would deadlock the actor
-        loop this controller runs on."""
+        Bounded by ``RT_SERVE_DRAIN_S`` (poll cadence
+        ``RT_SERVE_DRAIN_POLL_S``): a wedged request must not block
+        scale-down forever.  Streams still live at the deadline are
+        killed with the replica and complete through the ingress's
+        mid-stream failover — counted as ``drain_handoffs`` and logged
+        as a drain_timeout so operators can tell graceful drains from
+        forced ones.  Async kill: the blocking ray_tpu.kill would
+        deadlock the actor loop this controller runs on."""
+        if drain_s is None:
+            drain_s = _env_f("RT_SERVE_DRAIN_S", 10.0)
+        poll_s = max(0.01, _env_f("RT_SERVE_DRAIN_POLL_S", 0.1))
         deadline = time.monotonic() + drain_s
-        while time.monotonic() < deadline:
+        leftover = 0
+        while True:
             try:
-                n = await asyncio.wait_for(handle.queue_len.remote(),
-                                           timeout=2)
-                if n == 0:
-                    break
+                leftover = await asyncio.wait_for(
+                    handle.queue_len.remote(), timeout=2)
             except Exception:
+                leftover = 0
                 break   # dead/unreachable: nothing to drain
-            await asyncio.sleep(0.1)
+            if leftover == 0 or time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(poll_s)
+        if leftover:
+            logger.warning(
+                "serve: drain_timeout — replica %s still had %d in-flight "
+                "request(s) after %.1fs; force-failing them over",
+                handle._actor_id[:8], leftover, drain_s)
+            from ray_tpu.serve import metrics as serve_metrics
+            serve_metrics.bump("drain_handoffs", leftover)
         from ray_tpu._private.worker import get_core
         try:
             await get_core().gcs.request({"type": "kill_actor",
@@ -330,6 +400,56 @@ class ServeController:
         # keep the health-grace bookkeeping bounded under replica churn
         self._replica_created.pop(handle._actor_id, None)
         self._replica_seen_healthy.discard(handle._actor_id)
+
+    async def rolling_restart(self, name: str) -> Dict[str, Any]:
+        """Replace every replica of ``name`` one at a time with zero
+        dropped streams (reference: deployment_state's rolling update,
+        one-at-a-time flavor).  Per replica: (1) surge-create the
+        replacement and wait until it answers a ping, so serving capacity
+        never dips below target; (2) under the reconcile lock, swap it
+        into the routing set and bump the long-poll version — routers and
+        ingresses stop sending to the victim push-style BEFORE it stops;
+        (3) outside the lock, drain the victim (RT_SERVE_DRAIN_S) and
+        kill it — streams still live at the drain deadline complete
+        through the ingress's mid-stream failover (drain_handoffs)."""
+        await self._maybe_restore()
+        await self._ensure_loop()
+        spec = self.deployments.get(name)
+        if spec is None:
+            raise ValueError(f"no deployment named {name!r}")
+        old_ids = [r._actor_id for r in self.replicas.get(name, [])]
+        replaced = 0
+        skipped = 0
+        for aid in old_ids:
+            async with self._reconcile_lock:
+                reps = self.replicas.setdefault(name, [])
+                victim = next(
+                    (r for r in reps if r._actor_id == aid), None)
+                if victim is None:
+                    skipped += 1   # died and was replaced mid-rollout
+                    continue
+                fresh = await self._create_replica(name, spec)
+                try:
+                    await asyncio.wait_for(fresh.ping.remote(),
+                                           timeout=120)
+                    self._replica_seen_healthy.add(fresh._actor_id)
+                except Exception:
+                    await self._kill_replica(fresh, drain_s=0)
+                    raise RuntimeError(
+                        f"rolling_restart({name!r}): replacement replica "
+                        "failed to become ready; aborting rollout")
+                reps.remove(victim)
+                reps.append(fresh)
+                # Stop-routing-first: the version bump reaches routers
+                # and ingresses (long-poll push) before the victim is
+                # touched, so no NEW request lands on it while draining.
+                self._bump_version(name)
+            await self._kill_replica(victim)
+            replaced += 1
+        logger.info("serve: rolling restart of %s replaced %d replica(s)"
+                    " (%d already gone)", name, replaced, skipped)
+        return {"deployment": name, "replaced": replaced,
+                "skipped": skipped}
 
     async def delete_deployment(self, name: str) -> bool:
         # Under the reconcile lock: an in-flight reconcile that already
@@ -425,10 +545,35 @@ class ServeController:
             "type": "kv_put", "ns": "serve", "key": b"state",
             "value": cloudpickle.dumps(state), "overwrite": True})
 
-    async def _reconcile_once(self):
+    async def _create_replica(self, name: str, spec: DeploymentSpec):
+        """Create one replica actor for ``name`` and return its handle.
+        Callers must hold ``_reconcile_lock`` (or be the reconcile loop
+        itself) — creation mutates the shared replica bookkeeping."""
         from ray_tpu._private.worker import get_core
         from ray_tpu.actor import ActorHandle
+        self._replica_seq += 1
+        resources = {"CPU": spec.num_cpus, **(spec.resources or {})}
+        # max_concurrency has headroom over the request bound: requests
+        # queue inside the replica (visible to queue_len) instead of at
+        # the actor layer.
+        scheduling = None
+        if spec.runtime_env:
+            from ray_tpu.remote_function import _build_scheduling
+            scheduling = _build_scheduling(
+                {"runtime_env": spec.runtime_env})
+        actor_id = await get_core().create_actor_async(
+            Replica,
+            (spec.callable_blob, spec.max_concurrent_queries,
+             spec.user_config),
+            {},
+            resources=resources,
+            scheduling=scheduling,
+            max_concurrency=4 * spec.max_concurrent_queries + 8,
+            name=f"_serve:{name}:{self._replica_seq}")
+        self._replica_created[actor_id] = time.monotonic()
+        return ActorHandle(actor_id, "Replica")
 
+    async def _reconcile_once(self):
         async def probe(r):
             aid = r._actor_id
             fresh = aid not in self._replica_seen_healthy
@@ -467,29 +612,7 @@ class ServeController:
                         await self._kill_replica(r)
                 reps[:] = [r for r, ok in zip(reps, oks) if ok]
                 while len(reps) < target:
-                    self._replica_seq += 1
-                    resources = {"CPU": spec.num_cpus,
-                                 **(spec.resources or {})}
-                    # max_concurrency has headroom over the request bound:
-                    # requests queue inside the replica (visible to
-                    # queue_len) instead of at the actor layer.
-                    scheduling = None
-                    if spec.runtime_env:
-                        from ray_tpu.remote_function import \
-                            _build_scheduling
-                        scheduling = _build_scheduling(
-                            {"runtime_env": spec.runtime_env})
-                    actor_id = await get_core().create_actor_async(
-                        Replica,
-                        (spec.callable_blob, spec.max_concurrent_queries,
-                         spec.user_config),
-                        {},
-                        resources=resources,
-                        scheduling=scheduling,
-                        max_concurrency=4 * spec.max_concurrent_queries + 8,
-                        name=f"_serve:{name}:{self._replica_seq}")
-                    self._replica_created[actor_id] = time.monotonic()
-                    reps.append(ActorHandle(actor_id, "Replica"))
+                    reps.append(await self._create_replica(name, spec))
                 victims = []
                 while len(reps) > target:
                     victims.append(reps.pop())

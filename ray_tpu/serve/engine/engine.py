@@ -32,10 +32,12 @@ import collections
 import concurrent.futures
 import dataclasses
 import logging
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu.serve import resilience
 from ray_tpu.serve.engine.kv_cache import PageAllocator, table_row
 
 logger = logging.getLogger(__name__)
@@ -58,11 +60,14 @@ class EngineConfig:
 
 class _Sequence:
     __slots__ = ("prompt", "max_new", "pages", "row", "queue", "generated",
-                 "pos", "last_token", "cancelled", "slot", "prefilled")
+                 "pos", "last_token", "cancelled", "slot", "prefilled",
+                 "deadline")
 
-    def __init__(self, prompt: List[int], max_new: int):
+    def __init__(self, prompt: List[int], max_new: int,
+                 deadline: Optional[float] = None):
         self.prompt = prompt
         self.max_new = max_new
+        self.deadline = deadline       # absolute epoch seconds, or None
         self.pages: List[int] = []
         self.row: Optional[np.ndarray] = None
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -149,11 +154,16 @@ class InferenceEngine:
     # ------------------------------------------------------------- public
 
     async def generate(self, tokens: Sequence[int],
-                       max_new_tokens: Optional[int] = None
+                       max_new_tokens: Optional[int] = None,
+                       deadline: Optional[float] = None
                        ) -> AsyncIterator[int]:
         """Admit one sequence; yields generated token ids as they decode.
         Closing the iterator early (client disconnect) cancels the
-        sequence and frees its pages at the next step boundary."""
+        sequence and frees its pages at the next step boundary.  An
+        absolute ``deadline`` (epoch seconds) bounds the whole request:
+        expiry raises DeadlineExceeded to the consumer AND retires the
+        sequence inside the batch loop — its slot and KV pages free at
+        the next step boundary instead of decoding tokens nobody reads."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
@@ -163,12 +173,23 @@ class InferenceEngine:
         max_new = min(max_new_tokens or self.config.max_new_tokens,
                       self.config.max_new_tokens)
         self._ensure_loop()
-        seq = _Sequence(tokens, max_new)
+        seq = _Sequence(tokens, max_new, deadline)
         self._waiting.append(seq)
         self._wake.set()
         try:
             while True:
-                item = await seq.queue.get()
+                if seq.deadline is None:
+                    item = await seq.queue.get()
+                else:
+                    rem = seq.deadline - time.time()
+                    if rem <= 0:
+                        raise resilience.DeadlineExceeded(
+                            "deadline expired while decoding")
+                    try:
+                        item = await asyncio.wait_for(seq.queue.get(), rem)
+                    except asyncio.TimeoutError:
+                        raise resilience.DeadlineExceeded(
+                            "deadline expired while decoding") from None
                 if item is _DONE:
                     return
                 if isinstance(item, BaseException):
@@ -198,11 +219,22 @@ class InferenceEngine:
     def _pages_needed(self, seq: _Sequence) -> int:
         return -(-(len(seq.prompt) + seq.max_new) // self.config.page_size)
 
+    @staticmethod
+    def _deadline_expired(seq: _Sequence) -> bool:
+        return seq.deadline is not None and time.time() > seq.deadline
+
     def _admit(self):
         while self._waiting and self._free_slots:
             seq = self._waiting[0]
             if seq.cancelled:
                 self._waiting.popleft()
+                continue
+            if self._deadline_expired(seq):
+                # Expired while queued: reject instead of spending pages
+                # and decode steps on a request nobody is waiting for.
+                self._waiting.popleft()
+                seq.queue.put_nowait(resilience.DeadlineExceeded(
+                    "deadline expired while waiting for admission"))
                 continue
             need = self._pages_needed(seq)
             if not self._alloc.can_alloc(need):
@@ -251,6 +283,15 @@ class InferenceEngine:
             try:
                 for seq in [s for s in self._active.values() if s.cancelled]:
                     self._retire(seq, done=False)
+                # Deadline sweep: an expired sequence stops decoding NOW —
+                # its slot and KV pages free for live requests and the
+                # rest of the batch keeps stepping unharmed.
+                for seq in [s for s in self._active.values()
+                            if self._deadline_expired(s)]:
+                    self._retire(seq, done=False)
+                    if not seq.cancelled:
+                        seq.queue.put_nowait(resilience.DeadlineExceeded(
+                            "deadline expired while decoding"))
                 self._admit()
                 if not self._active:
                     if self._waiting:
@@ -280,6 +321,14 @@ class InferenceEngine:
 
                 if not self._active:
                     continue
+                # Chaos hook: a stalled decode (wedged device, stuck
+                # dispatch) is indistinguishable from a dead replica to
+                # the client — the ingress's stall detector must fail the
+                # stream over.  The hook injects exactly that.
+                from ray_tpu.util import fault_injection
+                stall = fault_injection.stall_replica_decode_s()
+                if stall:
+                    await asyncio.sleep(stall)
                 # One batched decode step over every live slot.  Inactive
                 # slots run token 0 at pos 0 against an all-zero table
                 # row — their writes land in scratch page 0.
@@ -330,8 +379,12 @@ class LLMServer:
         if not isinstance(payload, dict) or "tokens" not in payload:
             raise ValueError(
                 'expected {"tokens": [...], "max_new_tokens": N}')
+        # The replica publishes the request's end-to-end deadline via
+        # contextvar (see serve/resilience.py); handing it to the engine
+        # lets an expired request free its KV pages mid-batch.
         async for tok in self._engine.generate(
-                payload["tokens"], payload.get("max_new_tokens")):
+                payload["tokens"], payload.get("max_new_tokens"),
+                deadline=resilience.current_deadline()):
             yield tok
 
     def stats(self) -> Dict[str, int]:

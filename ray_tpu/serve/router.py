@@ -8,6 +8,14 @@ controller (reference serve/_private/long_poll.py LongPollClient): scale
 events become visible push-style, typically within one RPC round-trip.
 The TTL refresh remains only as a safety net (listener thread died, or
 the controller was replaced).
+
+**Hardening** (serve/resilience.py): replica choice runs through a
+per-replica circuit breaker — callers report outcomes via
+``report_failure``/``report_success`` (or use ``remote_retrying``, which
+does it automatically plus bounded backoff retry), and ejected replicas
+drop out of the power-of-two candidate set until their half-open probe
+passes.  ``_deadline_s`` on ``remote``/``remote_stream`` propagates an
+end-to-end deadline to the replica (and through it, the engine).
 """
 
 from __future__ import annotations
@@ -16,9 +24,10 @@ import random
 import threading
 import time
 import weakref
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve import resilience
 
 # Fallback only — the long-poll listener delivers changes immediately.
 REFRESH_PERIOD_S = 30.0
@@ -41,6 +50,23 @@ class DeploymentHandle:
         self._version = 0
         self._listener: threading.Thread = None
         self._counters_reset_at = 0.0
+        self._cb = resilience.CircuitBreaker(on_open=self._on_cb_open)
+
+    @staticmethod
+    def _on_cb_open(replica_id: str):
+        from ray_tpu.serve import metrics as serve_metrics
+        serve_metrics.bump("circuit_open")
+
+    def report_failure(self, replica_id: str):
+        """Feed the circuit breaker: call with the replica's actor id
+        when a request sent through this handle failed with a system
+        error (replica death, lost connection)."""
+        with self._lock:
+            self._cb.record_failure(replica_id)
+
+    def report_success(self, replica_id: str):
+        with self._lock:
+            self._cb.record_success(replica_id)
 
     def __reduce__(self):
         # Handles travel into replicas for deployment graphs (a deployment
@@ -84,9 +110,16 @@ class DeploymentHandle:
             # a duplicate listener.
             t.start()
 
-    def _pick(self):
+    def _pick(self, exclude: Optional[set] = None):
         with self._lock:
             reps = list(self._replicas)
+            if reps:
+                # Breaker-filtered candidate set: ejected replicas sit out
+                # until their half-open probe; if EVERYTHING is ejected,
+                # fall back to the raw set (a request that might succeed
+                # beats a guaranteed routing error).
+                avail = self._cb.filter(reps, exclude=exclude)
+                reps = avail or reps
         if not reps:
             raise RuntimeError(
                 f"deployment {self._name} has no running replicas")
@@ -97,11 +130,11 @@ class DeploymentHandle:
         nb = self._outstanding.get(b._actor_id, 0)
         return a if na <= nb else b
 
-    def remote(self, *args, _method: str = None, **kwargs):
-        """Route one request; returns an ObjectRef of the result."""
-        self._refresh()
-        replica = self._pick()
-        aid = replica._actor_id
+    @staticmethod
+    def _deadline(deadline_s: Optional[float]) -> Optional[float]:
+        return None if deadline_s is None else time.time() + deadline_s
+
+    def _count(self, aid: str):
         now = time.monotonic()
         with self._lock:
             # In-flight estimate; reset wholesale on a short cadence rather
@@ -112,9 +145,61 @@ class DeploymentHandle:
                 self._outstanding = {}
                 self._counters_reset_at = now
             self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
-        return replica.handle_request.remote(list(args), kwargs, _method)
 
-    def remote_stream(self, *args, _method: str = None, **kwargs):
+    def remote(self, *args, _method: str = None,
+               _deadline_s: Optional[float] = None, **kwargs):
+        """Route one request; returns an ObjectRef of the result.
+        ``_deadline_s`` (relative seconds) rides to the replica as an
+        absolute end-to-end deadline — expiry raises DeadlineExceeded
+        from the ref instead of computing a result nobody will read."""
+        self._refresh()
+        replica = self._pick()
+        self._count(replica._actor_id)
+        return replica.handle_request.remote(
+            list(args), kwargs, _method, self._deadline(_deadline_s))
+
+    async def remote_retrying(self, *args, _method: str = None,
+                              _deadline_s: Optional[float] = None,
+                              **kwargs):
+        """Awaitable hardened call: routes like ``remote`` but awaits the
+        result, feeds the circuit breaker with the outcome, and retries
+        retryable system failures (replica death, lost connections) on a
+        different replica with exponential backoff + jitter, bounded by
+        the RT_SERVE_RETRY_BUDGET and the deadline.  Returns the result
+        directly (not an ObjectRef)."""
+        import asyncio
+        deadline = self._deadline(_deadline_s)
+        policy = resilience.RetryPolicy()
+        exclude: set = set()
+        while True:
+            rem = resilience.deadline_remaining(deadline)
+            if rem is not None and rem <= 0:
+                raise resilience.DeadlineExceeded(
+                    "request deadline expired before completion")
+            self._refresh()
+            replica = self._pick(exclude)
+            aid = replica._actor_id
+            self._count(aid)
+            try:
+                result = await replica.handle_request.remote(
+                    list(args), kwargs, _method, deadline)
+            except Exception as e:   # noqa: BLE001
+                if not resilience.is_retryable_error(e):
+                    raise
+                self.report_failure(aid)
+                exclude.add(aid)
+                if not policy.can_retry():
+                    raise
+                from ray_tpu.serve import metrics as serve_metrics
+                serve_metrics.bump("router_retries")
+                self._refresh(force=True)
+                await asyncio.sleep(policy.next_backoff_s(deadline))
+                continue
+            self.report_success(aid)
+            return result
+
+    def remote_stream(self, *args, _method: str = None,
+                      _deadline_s: Optional[float] = None, **kwargs):
         """Route one STREAMING request: returns a
         ``StreamingObjectRefGenerator`` whose items are the handler's
         yields, consumable while the replica is still generating
@@ -122,15 +207,10 @@ class DeploymentHandle:
         generator early cancels the replica-side stream."""
         self._refresh()
         replica = self._pick()
-        aid = replica._actor_id
-        now = time.monotonic()
-        with self._lock:
-            if now - self._counters_reset_at > COUNTER_RESET_PERIOD_S:
-                self._outstanding = {}
-                self._counters_reset_at = now
-            self._outstanding[aid] = self._outstanding.get(aid, 0) + 1
+        self._count(replica._actor_id)
         return replica.handle_stream.options(
-            num_returns="streaming").remote(list(args), kwargs, _method)
+            num_returns="streaming").remote(
+                list(args), kwargs, _method, self._deadline(_deadline_s))
 
     def method(self, name: str):
         """handle.method("encode").remote(...) calls a named method."""

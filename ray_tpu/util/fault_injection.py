@@ -66,6 +66,12 @@ class FaultSpec:
     # this process yields is delayed X seconds (models a wedged decode
     # step; drives the ingress stream-idle timeout).
     stall_stream: Optional[Any] = None
+    # stall_replica_decode: {"after": N, "stall_s": X} — the Nth batched
+    # decode step this process's inference engine dispatches is delayed X
+    # seconds (models a wedged device/dispatch: the replica actor stays
+    # ALIVE but produces no tokens; drives the ingress stall detector
+    # RT_SERVE_STALL_S into a mid-stream failover).
+    stall_replica_decode: Optional[Any] = None
     # partition: {"conn": substr, "after_s": N, "heal_s": M?} — a
     # control-plane partition window: ``after_s`` seconds into the
     # process's life, force-close (and refuse to redial) every connection
@@ -93,6 +99,7 @@ class FaultSpec:
             drop_fetch_reply=raw.get("drop_fetch_reply"),
             slow_client=raw.get("slow_client"),
             stall_stream=raw.get("stall_stream"),
+            stall_replica_decode=raw.get("stall_replica_decode"),
             partition=raw.get("partition"),
         )
 
@@ -284,6 +291,26 @@ def stall_stream_s() -> float:
     return 0.0
 
 
+def stall_replica_decode_s() -> float:
+    """Chaos hook in the inference engine's batch loop: seconds to stall
+    before dispatching the next decode step.  ``{"after": N,
+    "stall_s": X}`` stalls exactly the Nth step this process dispatches
+    (one-shot, deterministic) — an X past RT_SERVE_STALL_S makes the
+    replica look wedged to the ingress while its actor stays ALIVE,
+    forcing the stall-detection half of mid-stream failover (replica
+    death exercises the other half)."""
+    fault = spec().stall_replica_decode
+    if not fault:
+        return 0.0
+    after = int(fault.get("after", 1)) if isinstance(fault, dict) else 1
+    n = _counters.get("stall_replica_decode", 0) + 1
+    _counters["stall_replica_decode"] = n
+    if n == after:
+        return float(fault.get("stall_s", 60.0)) \
+            if isinstance(fault, dict) else 60.0
+    return 0.0
+
+
 # --------------------------------------------------------------- observers
 
 def _list_nodes() -> List[dict]:
@@ -328,6 +355,83 @@ def wait_alive_nodes(count: int, timeout: float = 120.0) -> List[dict]:
     raise TimeoutError(
         f"expected {count} alive nodes within {timeout}s, have "
         f"{len(alive)}")
+
+
+def wait_actor_dead(actor_id: str, timeout: float = 120.0) -> dict:
+    """Block until the GCS records ``actor_id`` as DEAD; returns its
+    actor record.  Same observed-state gating as wait_node_dead: chaos
+    tests assert on recorded death, not on wall-clock sleeps."""
+    from ray_tpu.util import state
+    deadline = time.monotonic() + timeout
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            for a in state.list_actors():
+                if a.get("actor_id") == actor_id and \
+                        a.get("state") == "DEAD":
+                    return a
+            last_err = None
+        except Exception as e:
+            last_err = e
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"actor {actor_id[:12]} not marked dead within {timeout}s"
+        + (f" (last query error: {last_err!r})" if last_err else ""))
+
+
+def kill_replica(deployment: Optional[str] = None, *,
+                 index: Optional[int] = None,
+                 actor_id: Optional[str] = None,
+                 mode: str = "sigkill",
+                 wait: bool = True,
+                 timeout: float = 120.0) -> dict:
+    """Kill one live serve replica mid-flight (chaos hook for the serving
+    fleet: failover, rolling restart, circuit-breaker tests).
+
+    Target selection: ``actor_id`` directly, or the ``index``-th (by
+    name, default first) ALIVE replica named ``_serve:<deployment>:*``.
+    ``mode="sigkill"`` SIGKILLs the hosting worker process — the abrupt
+    death, mid-decode, that failover must absorb (same-host clusters
+    only, like NodeKiller); it falls back to a GCS ``kill_actor`` when
+    the pid isn't known yet.  ``mode="kill"`` always goes through the
+    GCS.  With ``wait`` (default), returns only after the GCS records
+    the death, so callers can immediately assert on recovery."""
+    from ray_tpu.util import state
+    alive = [a for a in state.list_actors() if a.get("state") == "ALIVE"]
+    if actor_id is not None:
+        victims = [a for a in alive if a.get("actor_id") == actor_id]
+    elif deployment is not None:
+        prefix = f"_serve:{deployment}:"
+        victims = sorted(
+            (a for a in alive
+             if (a.get("name") or "").startswith(prefix)),
+            key=lambda a: a.get("name") or "")
+        if index is not None:
+            victims = victims[index:index + 1]
+    else:
+        raise ValueError("kill_replica needs deployment= or actor_id=")
+    if not victims:
+        raise RuntimeError(
+            f"no live replica to kill (deployment={deployment!r}, "
+            f"index={index}, actor_id={actor_id!r})")
+    victim = victims[0]
+    vid = victim["actor_id"]
+    pid = None
+    if mode == "sigkill":
+        for w in state.list_workers():
+            if w.get("actor_id") == vid and w.get("pid"):
+                pid = w["pid"]
+                break
+        if pid is not None:
+            os.kill(pid, signal.SIGKILL)
+    if pid is None:   # mode == "kill", or the pid never reached the GCS
+        state._gcs_request({"type": "kill_actor", "actor_id": vid,
+                            "no_restart": True})
+    record = {"actor_id": vid, "name": victim.get("name"),
+              "pid": pid, "time": time.time()}
+    if wait:
+        wait_actor_dead(vid, timeout=timeout)
+    return record
 
 
 class NodeKiller:

@@ -135,6 +135,35 @@ def autotune_totals() -> Dict[str, Any]:
     return out
 
 
+def serve_totals() -> Dict[str, Any]:
+    """Cluster-wide serve-resilience counters: requests re-routed after a
+    retryable failure (``router_retries``), circuit-breaker ejections
+    (``circuit_open``), SSE streams failed over and resumed mid-decode
+    (``streams_resumed``), and in-flight streams force-handed to failover
+    at a drain deadline (``drain_handoffs``) — combining raylet-side
+    counts ridden in over node stats (live + dead-node carry-over) with
+    the counters of the processes that actually route (ingress actors,
+    the controller, handle-holding workers) aggregated through the
+    user-metrics pipe (raylets never flush user metrics, so the two
+    sources never double count)."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    stats = reply.get("nodes", {})
+    dead = reply.get("dead_totals", {})
+    out: Dict[str, Any] = {}
+    for k in ("router_retries", "circuit_open", "streams_resumed",
+              "drain_handoffs"):
+        out[k] = dead.get(k, 0) + sum(s.get(k, 0) for s in stats.values())
+    try:
+        agg = _gcs_request({"type": "list_metrics"}) or []
+        for m in agg:
+            name = str(m.get("name", ""))
+            if name in out and m.get("type") == "counter":
+                out[name] += m.get("value", 0)
+    except Exception:
+        pass
+    return out
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects registered in the cluster object directory (plasma-sized;
     inline objects live in their owners and are not globally tracked)."""

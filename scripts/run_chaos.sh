@@ -19,13 +19,17 @@
 #            wire-chaos - wire-format faults only (dropped/garbled
 #                         v2 frames through the binary framing;
 #                         -m "chaos and wire_chaos")
+#            serve-fleet - serving-fleet resilience (SSE storm with a
+#                         mid-storm replica kill, rolling restart,
+#                         stalled-decode failover;
+#                         -m "chaos and serve_fleet")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
 PROFILE="all"
 case "${1:-}" in
-    all|data-chaos|partition-chaos|serve-chaos|wire-chaos)
+    all|data-chaos|partition-chaos|serve-chaos|wire-chaos|serve-fleet)
         PROFILE="$1"
         shift
         ;;
@@ -39,6 +43,8 @@ elif [ "$PROFILE" = "serve-chaos" ]; then
     MARKER="chaos and serve_chaos"
 elif [ "$PROFILE" = "wire-chaos" ]; then
     MARKER="chaos and wire_chaos"
+elif [ "$PROFILE" = "serve-fleet" ]; then
+    MARKER="chaos and serve_fleet"
 fi
 
 RUNS="${CHAOS_RUNS:-3}"

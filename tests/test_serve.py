@@ -409,7 +409,23 @@ def test_controller_crash_recovery(serve_cluster):
 
     h = serve.run(Echo.bind())
     _, pid_before = ray_tpu.get(h.remote(1))
-    time.sleep(1.2)                  # let a reconcile persist the state
+    # Wait until a reconcile has actually persisted the KV snapshot with
+    # both replicas — the persist runs on the 0.5s reconcile loop, and a
+    # wall-clock sleep races it on a loaded box.
+    import cloudpickle
+
+    from ray_tpu._private.kv import kv_get
+    deadline = time.monotonic() + 30
+    while True:
+        raw = kv_get(b"state", ns="serve")
+        if raw:
+            snap = cloudpickle.loads(raw)
+            if len(snap.get("deployments", {})
+                    .get("Echo", (None, 0, []))[2]) == 2:
+                break
+        assert time.monotonic() < deadline, \
+            "controller never persisted its state snapshot"
+        time.sleep(0.2)
 
     ctrl = ray_tpu.get_actor("_serve_controller")
     ray_tpu.kill(ctrl, no_restart=False)
