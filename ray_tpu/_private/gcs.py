@@ -445,7 +445,10 @@ class GcsServer:
                         "autotune_cache_hits", "autotune_cache_misses",
                         "autotune_tune_ms",
                         "router_retries", "circuit_open",
-                        "streams_resumed", "drain_handoffs")
+                        "streams_resumed", "drain_handoffs",
+                        "train_recoveries", "preemptions",
+                        "ckpt_write_ms", "ckpt_restore_ms",
+                        "ckpt_corrupt_skipped")
 
     def dead_spill_totals(self) -> Dict[str, int]:
         """Aggregate spill/restore/integrity counters folded from dead

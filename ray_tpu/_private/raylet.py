@@ -514,6 +514,15 @@ class Raylet:
             out.update(_serve_metrics.stats())
         except Exception:
             pass
+        try:
+            # Train resilience counters (gang recoveries, preemption
+            # handoffs, checkpoint write/restore/corruption) for THIS
+            # process; train-worker actors and driver supervisors reach
+            # the dashboard via util.metrics aggregation instead.
+            from ray_tpu.train import metrics as _train_metrics
+            out.update(_train_metrics.stats())
+        except Exception:
+            pass
         # loop_lag_ms is merged by the caller on the loop thread —
         # LoopWatchdog.record() mutates watchdog state.
         return out

@@ -224,7 +224,10 @@ class DashboardHttpServer:
                          "autotune_cache_hits", "autotune_cache_misses",
                          "autotune_tune_ms",
                          "router_retries", "circuit_open",
-                         "streams_resumed", "drain_handoffs"):
+                         "streams_resumed", "drain_handoffs",
+                         "train_recoveries", "preemptions",
+                         "ckpt_write_ms", "ckpt_restore_ms",
+                         "ckpt_corrupt_skipped"):
                 if name in st:
                     lag_records.append({
                         "name": name, "type": "counter",
@@ -240,18 +243,22 @@ class DashboardHttpServer:
         # raw records would emit duplicate series and drop histogram
         # buckets, and any per-endpoint renaming would give one metric two
         # series names depending on scrape point.
-        # Autotune and serve-resilience counters flow through the
-        # user-metrics pipe (worker processes flush them like any
-        # Counter) but are SYSTEM series: split them out under the
+        # Autotune, serve-resilience, and train-resilience counters flow
+        # through the user-metrics pipe (worker processes flush them like
+        # any Counter) but are SYSTEM series: split them out under the
         # ray_tpu_ prefix so operators find cache hit rate, failover
-        # counts, and circuit-breaker ejections next to the other health
+        # counts, and checkpoint health next to the other health
         # series, not namespaced as user metrics.
         _SERVE_COUNTERS = ("router_retries", "circuit_open",
                            "streams_resumed", "drain_handoffs")
+        _TRAIN_COUNTERS = ("train_recoveries", "preemptions",
+                           "ckpt_write_ms", "ckpt_restore_ms",
+                           "ckpt_corrupt_skipped")
         agg = self.gcs.aggregated_metrics()
         system = [m for m in agg
                   if str(m.get("name", "")).startswith("autotune_")
-                  or str(m.get("name", "")) in _SERVE_COUNTERS]
+                  or str(m.get("name", "")) in _SERVE_COUNTERS
+                  or str(m.get("name", "")) in _TRAIN_COUNTERS]
         user = [m for m in agg if m not in system]
         return "\n".join(lines) + "\n" + \
             render_prometheus(lag_records + system, prefix="ray_tpu_") + \

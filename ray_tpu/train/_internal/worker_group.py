@@ -13,12 +13,21 @@ import os
 import queue
 import socket
 import threading
+import time
 import traceback
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air import session as air_session
 from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Preempted(BaseException):
+    """Raised inside the train loop by session.report at the step boundary
+    after a preemption notice, unwinding the user fn AFTER its final
+    checkpoint-bearing report so the worker exits clean.  BaseException so
+    a user loop's broad ``except Exception`` cannot swallow the handoff."""
 
 
 class RayTrainWorker:
@@ -33,6 +42,9 @@ class RayTrainWorker:
         self._thread: Optional[threading.Thread] = None
         self._queue: "queue.Queue" = queue.Queue()
         self._ctx: Dict[str, Any] = {}
+        # Monotonic deadline by which this worker must be gone, set by a
+        # preempt() RPC or the preempt_notice fault; None = no notice.
+        self._preempt_deadline: Optional[float] = None
 
     # -- plumbing ---------------------------------------------------------
     def execute(self, fn: Callable, *args, **kwargs):
@@ -57,12 +69,36 @@ class RayTrainWorker:
     def set_context(self, **ctx):
         self._ctx.update(ctx)
 
+    # -- preemption -------------------------------------------------------
+    def preempt(self, grace_s: float = 30.0) -> bool:
+        """Deliver a preemption notice: the train loop finishes its
+        in-flight microbatch, writes a final checkpoint at the next step
+        boundary, and exits clean (a planned handoff, not a failure).
+        Callable as an actor RPC (max_concurrency > 1 lets it land while
+        the loop runs); the preempt_notice fault delivers the same signal
+        in-process for chaos tests."""
+        self._preempt_deadline = time.monotonic() + float(grace_s)
+        return True
+
+    def _preempt_deadline_check(self) -> Optional[float]:
+        """The active grace deadline, arming the fault-injected notice on
+        first observation past its fire time.  Consulted by the session at
+        every report (step boundary)."""
+        if self._preempt_deadline is None:
+            from ray_tpu.util import fault_injection
+            notice = fault_injection.preempt_notice_at(
+                self._ctx.get("world_rank", 0))
+            if notice is not None and time.monotonic() >= notice[0]:
+                self._preempt_deadline = notice[0] + notice[1]
+        return self._preempt_deadline
+
     # -- training ---------------------------------------------------------
     def start_training(self, train_fn: Callable,
                        config: Optional[Dict[str, Any]],
                        checkpoint: Optional[Checkpoint]):
         ctx = self._ctx
         q = self._queue
+        worker = self
 
         class _TrainSession(air_session._SessionBase):
             world_rank = ctx.get("world_rank", 0)
@@ -76,6 +112,17 @@ class RayTrainWorker:
 
             def report(self, metrics, ckpt=None):
                 q.put(("report", metrics, ckpt))
+                # Step boundary = the preemption exit point: leave after
+                # the first checkpoint-bearing report once noticed, or at
+                # any report past the grace deadline (the platform is
+                # about to SIGKILL us; clean exit without a fresh
+                # checkpoint still beats an unplanned death).
+                deadline = worker._preempt_deadline_check()
+                if deadline is not None and (
+                        ckpt is not None or time.monotonic() >= deadline):
+                    raise Preempted(
+                        f"rank={self.world_rank} preempted "
+                        f"(grace deadline {deadline:.1f})")
 
             def get_checkpoint(self):
                 return checkpoint
@@ -93,6 +140,8 @@ class RayTrainWorker:
                 else:
                     result = train_fn()
                 q.put(("done", result, None))
+            except Preempted as e:
+                q.put(("preempted", str(e), None))
             except BaseException as e:  # noqa: BLE001 - forwarded to driver
                 q.put(("error", repr(e), traceback.format_exc()))
             finally:
@@ -115,6 +164,7 @@ class Worker:
     def __init__(self, actor, rank: int):
         self.actor = actor
         self.rank = rank
+        self.actor_id: str = getattr(actor, "_actor_id_hex", "")
         self.ip: str = ""
         self.node_rank: int = 0
         self.local_rank: int = 0
@@ -124,8 +174,13 @@ class WorkerGroup:
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
                  placement_group=None,
-                 bundle_offset: int = 0):
+                 bundle_offset: int = 0,
+                 group_id: Optional[str] = None):
         self._num_workers = num_workers
+        # Workers get GCS-registered names (_train:<gang>:<rank>) so the
+        # gang supervisor's death watch and chaos's kill_train_worker can
+        # target them by identity — ActorInfo carries no class name.
+        self.group_id = group_id or uuid.uuid4().hex[:8]
         cls = ray_tpu.remote(RayTrainWorker)
         self.workers: List[Worker] = []
         for rank in range(num_workers):
@@ -133,6 +188,7 @@ class WorkerGroup:
                 "num_cpus": resources_per_worker.get("CPU", 1.0),
                 "num_tpus": resources_per_worker.get("TPU", 0.0),
                 "max_concurrency": 4,
+                "name": f"_train:{self.group_id}:{rank}",
             }
             extra = {k: v for k, v in resources_per_worker.items()
                      if k not in ("CPU", "TPU")}

@@ -53,8 +53,12 @@ class DataParallelTrainer(BaseTrainer):
             while True:
                 try:
                     results = executor.get_next_results()
-                except TrainingWorkerError:
-                    if not executor.recover(train_fn, config):
+                except TrainingWorkerError as e:
+                    # A planned preemption handoff (worker checkpointed and
+                    # exited clean) restarts without burning the budget.
+                    if not executor.recover(
+                            train_fn, config,
+                            preempted=getattr(e, "preempted", False)):
                         raise
                     continue
                 if results is None:

@@ -9,31 +9,124 @@ onto the target mesh without materializing full arrays on one host.
 Wraps into the AIR ``Checkpoint`` envelope so Train/Tune plumbing
 (session.report, resume_from_checkpoint, Result.checkpoint) is
 unchanged.
+
+Crash consistency (same protocol as _internal/checkpoint_store.py):
+Orbax writes land in a ``.writing`` sibling first; every file is fsynced
+and recorded (size + crc32) in an ``RT_MANIFEST.json`` written LAST via
+the durable tmp→fsync→rename pattern, then the whole directory renames
+into place.  A crash at any point leaves either the previous checkpoint
+or a ``.writing`` orphan — never a torn directory at the committed path.
+Restore re-verifies the manifest and raises ``CorruptCheckpointError``
+on any mismatch so callers (the gang supervisor's verified-checkpoint
+gate) fall back instead of loading garbage.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, Dict, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train._internal.checkpoint_store import (
+    CorruptCheckpointError, file_crc32, write_file_durable)
+
+RT_MANIFEST = "RT_MANIFEST.json"
+
+
+def _seal_dir(root: str) -> None:
+    """fsync every file under ``root`` and commit an RT_MANIFEST.json
+    (relative path → size + crc32) as the LAST durable write.  The
+    manifest must never attest to data still in the page cache, hence
+    the per-file fsync before it is written."""
+    files: Dict[str, Dict[str, int]] = {}
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            fd = os.open(full, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            files[rel] = {"size": os.path.getsize(full),
+                          "crc32": file_crc32(full)}
+    write_file_durable(
+        os.path.join(root, RT_MANIFEST),
+        json.dumps({"format": 1, "files": files},
+                   sort_keys=True).encode("utf-8"))
+
+
+def _publish_dir(tmp: str, path: str) -> None:
+    """Atomically rename the sealed ``tmp`` directory to ``path`` and make
+    the rename itself durable (parent-directory fsync)."""
+    shutil.rmtree(path, ignore_errors=True)
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def verify_sharded(path: str) -> Dict[str, Any]:
+    """Verify a sealed checkpoint directory against its RT_MANIFEST.json;
+    returns the manifest.  Raises CorruptCheckpointError when the manifest
+    is missing/torn or any listed file is missing, short, or fails crc32
+    (a manifest-less directory at a committed path means the writer
+    predates the seal protocol or the manifest itself was lost — treat it
+    as partial either way)."""
+    mpath = os.path.join(path, RT_MANIFEST)
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
+        raise CorruptCheckpointError(
+            f"{path}: no {RT_MANIFEST} (partial/unsealed write)") from None
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        raise CorruptCheckpointError(f"{path}: torn manifest: {e}") from e
+    for rel, rec in (manifest.get("files") or {}).items():
+        full = os.path.join(path, rel)
+        try:
+            size = os.path.getsize(full)
+        except OSError:
+            raise CorruptCheckpointError(
+                f"{path}: file {rel} missing") from None
+        if size != int(rec["size"]):
+            raise CorruptCheckpointError(
+                f"{path}: file {rel} is {size} bytes, manifest says "
+                f"{rec['size']} (torn write)")
+        if file_crc32(full) != int(rec["crc32"]):
+            raise CorruptCheckpointError(
+                f"{path}: file {rel} failed crc32 verification")
+    return manifest
 
 
 def save_sharded(path: str, tree: Any) -> str:
-    """Write a (possibly sharded) pytree of jax.Arrays with Orbax.
+    """Write a (possibly sharded) pytree of jax.Arrays with Orbax,
+    crash-consistently.
 
     Under a Mesh each process writes only its addressable shards;
-    single-process saves degrade to a normal array dump."""
+    single-process saves degrade to a normal array dump.  The write goes
+    to ``<path>.writing``, is sealed (fsync + CRC manifest), and renames
+    into place — a crash never leaves a torn directory at ``path``."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
+    tmp = path + ".writing"
+    shutil.rmtree(tmp, ignore_errors=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, tree, force=True)
+    ckptr.save(tmp, tree, force=True)
     ckptr.wait_until_finished()
+    _seal_dir(tmp)
+    _publish_dir(tmp, path)
     return path
 
 
 def restore_sharded(path: str, target: Optional[Any] = None) -> Any:
-    """Restore an Orbax checkpoint.
+    """Restore an Orbax checkpoint, verifying its seal first (raises
+    CorruptCheckpointError on a torn/corrupt directory so callers fall
+    back to a previous intact checkpoint instead of loading garbage).
 
     ``target``: a pytree of abstract shapes/arrays carrying shardings
     (e.g. the freshly-initialized, mesh-sharded params) — shards load
@@ -42,6 +135,7 @@ def restore_sharded(path: str, target: Optional[Any] = None) -> Any:
     import jax
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
+    verify_sharded(path)
     ckptr = ocp.StandardCheckpointer()
     if target is not None:
         abstract = jax.tree.map(
@@ -61,17 +155,23 @@ class JaxCheckpoint(Checkpoint):
     @classmethod
     def from_sharded_state(cls, tree: Any, *, path: Optional[str] = None,
                            **extra) -> "JaxCheckpoint":
-        import json
         import tempfile
-        path = path or tempfile.mkdtemp(prefix="rt-orbax-")
-        save_sharded(os.path.join(path, "state"), tree)
+        path = os.path.abspath(path or tempfile.mkdtemp(prefix="rt-orbax-"))
+        # Assemble state + meta in a sibling and rename the WHOLE envelope
+        # at once, so a crash can't publish state without its meta (or
+        # either half torn).
+        tmp = path + ".writing"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        save_sharded(os.path.join(tmp, "state"), tree)
         if extra:
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(extra, f, default=str)
+            write_file_durable(
+                os.path.join(tmp, "meta.json"),
+                json.dumps(extra, default=str).encode("utf-8"))
+        _publish_dir(tmp, path)
         return cls.from_directory(path)
 
     def meta(self) -> dict:
-        import json
         p = os.path.join(self.to_directory(), "meta.json")
         if os.path.exists(p):
             with open(p) as f:

@@ -164,6 +164,35 @@ def serve_totals() -> Dict[str, Any]:
     return out
 
 
+def train_totals() -> Dict[str, Any]:
+    """Cluster-wide training-resilience counters: gang restarts after an
+    unplanned worker death (``train_recoveries``), planned preemption
+    handoffs (``preemptions``), cumulative durable checkpoint write and
+    verified restore wall-clock (``ckpt_write_ms`` / ``ckpt_restore_ms``),
+    and checkpoints rejected by CRC/manifest verification at restore
+    (``ckpt_corrupt_skipped``) — combining raylet-side counts ridden in
+    over node stats (live + dead-node carry-over) with the counters of
+    the processes that actually train (worker actors, the driver
+    supervisor) aggregated through the user-metrics pipe (raylets never
+    flush user metrics, so the two sources never double count)."""
+    reply = _gcs_request({"type": "get_node_stats"}) or {}
+    stats = reply.get("nodes", {})
+    dead = reply.get("dead_totals", {})
+    out: Dict[str, Any] = {}
+    for k in ("train_recoveries", "preemptions", "ckpt_write_ms",
+              "ckpt_restore_ms", "ckpt_corrupt_skipped"):
+        out[k] = dead.get(k, 0) + sum(s.get(k, 0) for s in stats.values())
+    try:
+        agg = _gcs_request({"type": "list_metrics"}) or []
+        for m in agg:
+            name = str(m.get("name", ""))
+            if name in out and m.get("type") == "counter":
+                out[name] += m.get("value", 0)
+    except Exception:
+        pass
+    return out
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects registered in the cluster object directory (plasma-sized;
     inline objects live in their owners and are not globally tracked)."""

@@ -23,13 +23,17 @@
 #                         mid-storm replica kill, rolling restart,
 #                         stalled-decode failover;
 #                         -m "chaos and serve_fleet")
+#            train-chaos - train gang resilience (mid-run SIGKILL with
+#                         bit-identical recovery, preempt-notice clean
+#                         handoff, torn-checkpoint CRC fallback;
+#                         -m "chaos and train_chaos")
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
 PROFILE="all"
 case "${1:-}" in
-    all|data-chaos|partition-chaos|serve-chaos|wire-chaos|serve-fleet)
+    all|data-chaos|partition-chaos|serve-chaos|wire-chaos|serve-fleet|train-chaos)
         PROFILE="$1"
         shift
         ;;
@@ -45,6 +49,8 @@ elif [ "$PROFILE" = "wire-chaos" ]; then
     MARKER="chaos and wire_chaos"
 elif [ "$PROFILE" = "serve-fleet" ]; then
     MARKER="chaos and serve_fleet"
+elif [ "$PROFILE" = "train-chaos" ]; then
+    MARKER="chaos and train_chaos"
 fi
 
 RUNS="${CHAOS_RUNS:-3}"
