@@ -86,14 +86,48 @@ def DEFAULT_MAX_RETRIES() -> int:
     return _rt_config().task_max_retries
 
 
-def _serialize_exception(e: BaseException) -> bytes:
-    tb = traceback.format_exc()
+def _dumps_exception(e: BaseException, tb: str) -> bytes:
+    """Pickle an (exception, traceback-text) error payload.  Blocking and
+    potentially unbounded (user exception state) — call it on an executor
+    thread from loop code; see _serialize_exception_async."""
     try:
         payload = cloudpickle.dumps((e, tb))
     except Exception:
         payload = cloudpickle.dumps(
             (RuntimeError(f"{type(e).__name__}: {e} (original unpicklable)"), tb))
     return payload
+
+
+def _serialize_exception(e: BaseException) -> bytes:
+    """Sync error serialization — exec threads and other off-loop callers
+    only; loop code awaits _serialize_exception_async instead."""
+    return _dumps_exception(e, traceback.format_exc())
+
+
+async def _serialize_exception_async(e: BaseException,
+                                     tb: Optional[str] = None) -> bytes:
+    """Error serialization for loop code: the traceback text is captured
+    here (while the except context is live) but the pickling — unbounded,
+    user-controlled work — runs on the default executor so heartbeats and
+    replies sharing the loop never stall behind it."""
+    if tb is None:
+        tb = traceback.format_exc()
+    return await asyncio.get_running_loop().run_in_executor(
+        None, _dumps_exception, e, tb)
+
+
+async def _dumps_off_loop(obj) -> bytes:
+    """cloudpickle.dumps on the default executor (rare-path payloads
+    built from loop code)."""
+    return await asyncio.get_running_loop().run_in_executor(
+        None, cloudpickle.dumps, obj)
+
+
+async def _loads_off_loop(payload):
+    """cloudpickle.loads on the default executor (rare-path payloads
+    decoded on loop code)."""
+    return await asyncio.get_running_loop().run_in_executor(
+        None, cloudpickle.loads, payload)
 
 
 class ExecChannel:
@@ -135,7 +169,11 @@ class ExecChannel:
                     continue
                 try:
                     ok, res = True, fn()
-                except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
+                # rtlint: disable=cancellation-safety - thread boundary:
+                # the exception (incl. KeyboardInterrupt from force-cancel)
+                # is forwarded to the awaiting future by _finish_batch, not
+                # swallowed; raising here would kill the shared exec thread.
+                except BaseException as e:  # noqa: BLE001
                     ok, res = False, e
                 done.append((fut, ok, res))
                 if time.monotonic() >= deadline:
@@ -516,6 +554,10 @@ class CoreWorker:
             return {"status": kind, "data": data}
         if kind == "err":
             return {"status": "error", "data": data}
+        if kind == "cancel":
+            # Pickle-free cancellation marker: the payload is just the
+            # message text, rebuilt into TaskCancelledError by the reader.
+            return {"status": "cancelled", "data": data}
         # "plasma" and "cval" (a client-mode byte cache layered over a
         # plasma object) both answer 'plasma': cluster workers must keep
         # pulling node-to-node instead of streaming through the client
@@ -587,8 +629,9 @@ class CoreWorker:
             entry = self.memory_store.get(st["ref0"])
             if entry is not None:
                 self._streams.pop(task_id_hex, None)
-                if entry[0] == "err":
-                    self._materialize(entry)   # raises the task's error
+                if entry[0] in ("err", "cancel"):
+                    # raises the task's error (decode off-loop)
+                    await self._materialize_async(entry)
                 raise StopAsyncIteration
             st["event"].clear()
             ev0 = self.object_events.setdefault(st["ref0"], asyncio.Event())
@@ -887,21 +930,39 @@ class CoreWorker:
 
     async def get_async(self, ref: ObjectRef) -> Any:
         data = await self._resolve_bytes(ref.id, ref.owner_address)
-        return self._materialize(data)
+        return await self._materialize_async(data)
 
     def _materialize(self, data):
+        """Sync decode — off-loop callers (driver threads via _run).  Loop
+        code awaits _materialize_async so error unpickling (unbounded,
+        user exception state) never runs on the IO loop."""
         kind, payload = data
+        if kind == "err":
+            self._raise_err(cloudpickle.loads(payload))
+        return self._materialize_value(kind, payload)
+
+    async def _materialize_async(self, data):
+        kind, payload = data
+        if kind == "err":
+            self._raise_err(await _loads_off_loop(payload))
+        return self._materialize_value(kind, payload)
+
+    def _materialize_value(self, kind, payload):
         if kind == "pval":
             return payload       # raw primitive: the value IS the payload
         if kind == "ndval":
             return self._rebuild_ndarray(("nd",) + tuple(payload))
-        if kind == "err":
-            e, tb = cloudpickle.loads(payload)
-            if isinstance(e, rex.RayTpuError):
-                raise e
-            raise rex.TaskError(e, tb)
+        if kind == "cancel":
+            raise rex.TaskCancelledError(payload)
         value = self.ser.deserialize(memoryview(payload))
         return value
+
+    @staticmethod
+    def _raise_err(decoded):
+        e, tb = decoded
+        if isinstance(e, rex.RayTpuError):
+            raise e
+        raise rex.TaskError(e, tb)
 
     async def _resolve_bytes(self, oid: ObjectID, owner: str,
                              deadline: Optional[float] = None):
@@ -911,7 +972,7 @@ class CoreWorker:
         while True:
             entry = self.memory_store.get(h)
             if entry is not None and entry[0] in ("val", "err", "pval",
-                                                  "ndval"):
+                                                  "ndval", "cancel"):
                 return entry
             if entry is not None and entry[0] == "cval":
                 return ("val", entry[1])   # client-mode byte cache
@@ -963,6 +1024,8 @@ class CoreWorker:
                         return (reply["status"], reply["data"])
                     if reply["status"] == "error":
                         return ("err", reply["data"])
+                    if reply["status"] == "cancelled":
+                        return ("cancel", reply["data"])
                     if reply["status"] == "plasma":
                         if self.plasma is None:
                             # Client mode: no store to pull into — stream
@@ -1248,7 +1311,9 @@ class CoreWorker:
                                               "key": fid.encode()})
             if payload is None:
                 raise RuntimeError(f"function {fid} not found in GCS")
-            fn = cloudpickle.loads(payload)
+            # Closure unpickling is unbounded user work — keep it off the
+            # IO loop (the fetch is once per function id, then cached).
+            fn = await _loads_off_loop(payload)
             self._function_cache[fid] = fn
         return fn
 
@@ -1387,7 +1452,7 @@ class CoreWorker:
                 return self._rebuild_ndarray(entry)
             _, oid_hex, owner = entry
             data = await self._resolve_bytes(ObjectID.from_hex(oid_hex), owner)
-            return self._materialize(data)
+            return await self._materialize_async(data)
 
         args = list(await asyncio.gather(*[one(e) for e in args_entries]))
         kwargs = {}
@@ -1417,8 +1482,6 @@ class CoreWorker:
         return_ids = [ObjectID.for_task_return(task_id, i)
                       for i in range(n_pre)]
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
-        if num_returns == "streaming":
-            self.register_stream(task_id.hex(), return_ids[0].hex())
         spec = {
             "task_id": task_id.hex(),
             "name": name or getattr(func, "__name__", "task"),
@@ -1483,7 +1546,17 @@ class CoreWorker:
 
             t.add_done_callback(_done)
 
-        self.loop.call_soon_threadsafe(_kick)
+        # Stream consumer state registers as late as possible — just
+        # before the task can be scheduled — so nothing between acquire
+        # and hand-off can throw and strand the entry; the hand-off
+        # itself (loop already closed) unregisters on the way out.
+        if num_returns == "streaming":
+            self.register_stream(task_id.hex(), return_ids[0].hex())
+        try:
+            self.loop.call_soon_threadsafe(_kick)
+        except BaseException:
+            self._streams.pop(tid_hex, None)
+            raise
         if num_returns == "streaming":
             return [object_ref_mod.StreamingObjectRefGenerator(
                 task_id.hex(), refs[0])]
@@ -1544,11 +1617,15 @@ class CoreWorker:
         return True
 
     def _store_cancelled(self, spec, return_ids):
-        payload = cloudpickle.dumps((rex.TaskCancelledError(
-            f"task {spec.get('name', '?')} "
-            f"({spec['task_id'][:8]}) was cancelled"), ""))
+        """Resolve a cancelled call's returns with the pickle-free
+        "cancel" store kind — just the message text; _materialize rebuilds
+        the TaskCancelledError.  Cancel storms (gang teardown cancelling
+        thousands of in-flight calls) then do zero serialization work on
+        the IO loop."""
+        msg = (f"task {spec.get('name', '?')} "
+               f"({spec['task_id'][:8]}) was cancelled")
         for oid in return_ids:
-            self._store_local(oid.hex(), "err", payload)
+            self._store_local(oid.hex(), "cancel", msg)
 
     async def _submit_and_track(self, spec, resources, scheduling, max_retries,
                                 retry_exceptions, return_ids,
@@ -1557,6 +1634,10 @@ class CoreWorker:
             await self._submit_and_track_inner(
                 spec, resources, scheduling, max_retries, retry_exceptions,
                 return_ids)
+        # rtlint: disable=cancellation-safety - this IS the cancel
+        # protocol's terminus: cancel_task() cancelled this very task,
+        # and the contract is to resolve the returns as cancelled, not to
+        # propagate out of the fire-and-forget submission wrapper.
         except asyncio.CancelledError:
             # Pending-phase ray_tpu.cancel(): the lease (if any) was
             # returned by _submit_once's finally on the way out.
@@ -1624,7 +1705,7 @@ class CoreWorker:
                 self._store_local(oid.hex(), "err", reply["error"])
             return
         err = last_err or rex.WorkerCrashedError("task failed")
-        payload = cloudpickle.dumps((err, ""))
+        payload = await _dumps_off_loop((err, ""))
         for oid in return_ids:
             self._store_local(oid.hex(), "err", payload)
 
@@ -1988,8 +2069,6 @@ class CoreWorker:
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
         for oid in return_ids:
             self.owned.add(oid.hex())
-        if num_returns == "streaming":
-            self.register_stream(task_id.hex(), return_ids[0].hex())
         call = {
             "type": "actor_call",
             "call_id": task_id.hex(),
@@ -2014,13 +2093,23 @@ class CoreWorker:
         # instead of per call.  Same-tick calls to one actor then ride a
         # single _BATCH frame (reference analog: direct actor transport
         # batching, src/ray/core_worker/transport/direct_actor_transport.cc).
-        with self._submit_lock:
-            self._submit_queue.append(
-                (actor_id_hex, call, return_ids, pinned_args))
-            wake = not self._submit_scheduled
-            self._submit_scheduled = True
-        if wake:
-            self.loop.call_soon_threadsafe(self._flush_submits)
+        # Stream state registers immediately before the queue hand-off
+        # (an already-scheduled flush may pick the entry up the moment it
+        # is appended); a failed hand-off unregisters on the way out so
+        # the owner's stream map can't grow a stranded entry.
+        if num_returns == "streaming":
+            self.register_stream(task_id.hex(), return_ids[0].hex())
+        try:
+            with self._submit_lock:
+                self._submit_queue.append(
+                    (actor_id_hex, call, return_ids, pinned_args))
+                wake = not self._submit_scheduled
+                self._submit_scheduled = True
+            if wake:
+                self.loop.call_soon_threadsafe(self._flush_submits)
+        except BaseException:
+            self._streams.pop(task_id.hex(), None)
+            raise
         if num_returns == "streaming":
             return [object_ref_mod.StreamingObjectRefGenerator(
                 task_id.hex(), refs[0])]
@@ -2052,7 +2141,7 @@ class CoreWorker:
         except Exception as e:  # noqa: BLE001 - actor dead/unknown
             err = (e if isinstance(e, rex.ActorDiedError)
                    else rex.ActorDiedError(str(e)))
-            payload = cloudpickle.dumps((err, ""))
+            payload = await _dumps_off_loop((err, ""))
             for _, call, return_ids, _pin in entries:
                 for oid in return_ids:
                     self._store_local(oid.hex(), "err", payload)
@@ -2096,9 +2185,24 @@ class CoreWorker:
         call, return_ids, pinned = meta
         try:
             reply = fut.result()
+        # rtlint: disable=cancellation-safety - reply-future reap, not a
+        # coroutine cancel: the protocol layer cancels pending reply
+        # futures on connection teardown, so CancelledError here means
+        # "connection died" unless the owner itself cancelled the call —
+        # which the flag check below resolves as cancelled.
         except (ConnectionLost, asyncio.CancelledError):
             st["conn"] = None
             st["address"] = None
+            cst = self._cancel_state.get(call["call_id"])
+            if cst is not None and cst.get("cancelled"):
+                # The owner cancelled this call (force-cancel tears the
+                # connection down); re-driving it through the fallback
+                # would resurrect a cancelled call on the restarted actor.
+                self._store_cancelled(
+                    {"name": call["method"], "task_id": call["call_id"]},
+                    return_ids)
+                self._finish_actor_entry(st, actor_id_hex, call, return_ids)
+                return
             spawn(self._group_fallback(
                 st, actor_id_hex, call, return_ids, pinned=pinned),
                 name="actor-group-fallback", log=logger)
@@ -2198,9 +2302,23 @@ class CoreWorker:
             else:
                 for oid in return_ids:
                     self._store_local(oid.hex(), "err", reply["error"])
+        # rtlint: disable=cancellation-safety - reply futures are
+        # cancelled on connection teardown, so CancelledError here is a
+        # transport signal, not a coroutine cancel; an owner-initiated
+        # cancel is honored via the flag check below instead of being
+        # re-driven through the retry path.
         except (ConnectionLost, asyncio.CancelledError):
             st["conn"] = None
             st["address"] = None
+            cst = self._cancel_state.get(call["call_id"])
+            if cst is not None and cst.get("cancelled"):
+                # Force-cancel killed the worker mid-call: that is the
+                # requested outcome — retrying against the restarted
+                # actor would resurrect the cancelled call.
+                self._store_cancelled(
+                    {"name": call["method"], "task_id": call["call_id"]},
+                    return_ids)
+                return
             info = await self.gcs.request({"type": "wait_actor_state",
                                            "actor_id": actor_id_hex})
             if info is not None and info["state"] == "ALIVE" and _retry < 3:
@@ -2208,13 +2326,13 @@ class CoreWorker:
                                               _retry + 1)
                 return
             cause = (info or {}).get("death_cause", "actor connection lost")
-            payload = cloudpickle.dumps(
+            payload = await _dumps_off_loop(
                 (rex.ActorDiedError(f"actor {actor_id_hex[:12]} died: {cause}"),
                  ""))
             for oid in return_ids:
                 self._store_local(oid.hex(), "err", payload)
         except Exception as e:
-            payload = cloudpickle.dumps((e, traceback.format_exc()))
+            payload = await _serialize_exception_async(e)
             for oid in return_ids:
                 self._store_local(oid.hex(), "err", payload)
 

@@ -96,6 +96,8 @@ async def amain(args) -> None:
         tmp = args.ready_file + ".tmp"
         with open(tmp, "w") as f:
             json.dump(ready, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, args.ready_file)
 
     # The raylet/GCS serve on this loop already — even the one-shot

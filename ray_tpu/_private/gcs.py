@@ -303,6 +303,8 @@ class GcsServer:
             tmp = self._persist_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(state, f)
+                f.flush()
+                _os.fsync(f.fileno())
             _os.replace(tmp, self._persist_path)
 
         await asyncio.get_running_loop().run_in_executor(None, _dump)
@@ -446,6 +448,7 @@ class GcsServer:
                         "autotune_tune_ms",
                         "router_retries", "circuit_open",
                         "streams_resumed", "drain_handoffs",
+                        "ctrl_reresolves",
                         "train_recoveries", "preemptions",
                         "ckpt_write_ms", "ckpt_restore_ms",
                         "ckpt_corrupt_skipped")

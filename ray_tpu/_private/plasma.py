@@ -200,11 +200,18 @@ class PlasmaClient:
         """Create+write+seal a multi-buffer object. Layout: see serialization.py."""
         total = sum(len(p) for p in payloads)
         buf = self.create(object_id, total, allow_evict=allow_evict)
-        pos = 0
-        for p in payloads:
-            buf[pos:pos + len(p)] = p
-            pos += len(p)
-        self.seal(object_id)
+        try:
+            pos = 0
+            for p in payloads:
+                buf[pos:pos + len(p)] = p
+                pos += len(p)
+            self.seal(object_id)
+        except BaseException:
+            # An unsealed buffer holds store memory forever AND blocks any
+            # re-put of this id — scrub it before surfacing the failure.
+            self.release(object_id)
+            self.delete(object_id)
+            raise
         self.release(object_id)  # drop the creator ref; LRU-managed now
         return total
 

@@ -1397,8 +1397,15 @@ class Raylet:
             return False
         if not self.plasma.contains(oid):
             buf = await self._create_with_spill(oid, len(data))
-            buf[:] = data
-            self.plasma.seal(oid)
+            try:
+                buf[:] = data
+                self.plasma.seal(oid)
+            except BaseException:
+                # Scrub the unsealed allocation or the id can never be
+                # restored again (create refuses an existing entry).
+                self.plasma.release(oid)
+                self.plasma.delete(oid)
+                raise
             self.plasma.release(oid)
             self._restored_objects += 1
         await self.gcs_conn.request({

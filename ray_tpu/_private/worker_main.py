@@ -232,6 +232,10 @@ class TaskExecutor:
                 run = lambda: fn(*args, **kwargs)  # noqa: E731
             try:
                 result = await self.core.exec_pool.run(run)
+            # rtlint: disable=cancellation-safety - executor side of the
+            # cancel protocol: the owner awaits this push reply and maps
+            # {"cancelled": True} to TaskCancelledError; propagating would
+            # kill the reply and hang the owner's get().
             except (KeyboardInterrupt, asyncio.CancelledError):
                 # ray_tpu.cancel(): either the injected thread interrupt
                 # or (pre-execution) this asyncio task's cancellation.
@@ -258,6 +262,9 @@ class TaskExecutor:
                                                   e.code or 0)
             return {"ok": False, "error": _serialize_exception(
                 RuntimeError("worker exited via SystemExit"))}
+        # rtlint: disable=cancellation-safety - executor side of the
+        # cancel protocol (see the exec_pool handler above): reply, don't
+        # propagate, or the owner's awaited push never resolves.
         except asyncio.CancelledError:
             # ray_tpu.cancel() during the load/resolve phase (cancel_task
             # cancelled this asyncio task).  Reply instead of propagating:
@@ -562,6 +569,9 @@ class TaskExecutor:
                       name="fast-reply-slow", log=logger)
                 return
             reply = {"ok": True, "returns": [entry]}
+        # rtlint: disable=cancellation-safety - done-callback reap of the
+        # exec future this worker's own _cancel_task cancelled; the
+        # cancelled reply is what resolves the owner's call.
         except asyncio.CancelledError:
             status = "FAILED"
             from ray_tpu import exceptions as rex
@@ -576,6 +586,10 @@ class TaskExecutor:
             from ray_tpu.exceptions import ActorDiedError
             reply = {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
+        # rtlint: disable=cancellation-safety - thread boundary: the
+        # exception is serialized into the reply and re-raised caller-side
+        # by _materialize, not swallowed; raising out of a done-callback
+        # would only reach the loop's exception handler.
         except BaseException as e:  # noqa: BLE001 - forwarded to caller
             status = "FAILED"
             reply = {"ok": False, "error": _serialize_exception(e)}
@@ -717,6 +731,9 @@ class TaskExecutor:
             from ray_tpu.exceptions import ActorDiedError
             return {"ok": False, "error": _serialize_exception(
                 ActorDiedError("actor exited via exit_actor()"))}
+        # rtlint: disable=cancellation-safety - executor side of the
+        # cancel protocol: the cancelled reply resolves the owner's call,
+        # and the order cursor must step or later calls deadlock.
         except asyncio.CancelledError:
             # ray_tpu.cancel() on this actor call while it was queued,
             # resolving args, or awaiting an async method.  The order

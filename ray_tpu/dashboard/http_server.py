@@ -225,6 +225,7 @@ class DashboardHttpServer:
                          "autotune_tune_ms",
                          "router_retries", "circuit_open",
                          "streams_resumed", "drain_handoffs",
+                         "ctrl_reresolves",
                          "train_recoveries", "preemptions",
                          "ckpt_write_ms", "ckpt_restore_ms",
                          "ckpt_corrupt_skipped"):
@@ -250,7 +251,8 @@ class DashboardHttpServer:
         # counts, and checkpoint health next to the other health
         # series, not namespaced as user metrics.
         _SERVE_COUNTERS = ("router_retries", "circuit_open",
-                           "streams_resumed", "drain_handoffs")
+                           "streams_resumed", "drain_handoffs",
+                           "ctrl_reresolves")
         _TRAIN_COUNTERS = ("train_recoveries", "preemptions",
                            "ckpt_write_ms", "ckpt_restore_ms",
                            "ckpt_corrupt_skipped")

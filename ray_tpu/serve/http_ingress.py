@@ -196,7 +196,12 @@ class HTTPIngress:
                 raise RuntimeError("serve controller not running")
             self._ctrl = ActorHandle(info["actor_id"], "ServeController")
             if self._ctrl_failures:
+                # Dual-sink: the local attribute feeds this ingress's
+                # stats(); the registry counter survives the node-stats ->
+                # GCS-fold -> /api/metrics chain (the attribute alone was
+                # invisible off-process).
                 self._ctrl_reresolves += 1
+                serve_metrics.bump("ctrl_reresolves")
             self._ctrl_failures = 0
         return self._ctrl
 

@@ -26,7 +26,10 @@ The four counters tell the resilience story end to end:
   on a healthy replica (the zero-dropped-streams invariant, countable);
 * ``drain_handoffs``  — in-flight streams a drain deadline force-handed
   to failover during replica replacement (each one is a drain that did
-  not complete gracefully).
+  not complete gracefully);
+* ``ctrl_reresolves`` — ingress re-resolutions of the serve controller
+  after failures (each one is a controller restart/outage the ingress
+  rode out; a climbing count means the control plane is flapping).
 """
 
 from __future__ import annotations
@@ -35,7 +38,7 @@ import threading
 from typing import Dict
 
 COUNTER_NAMES = ("router_retries", "circuit_open", "streams_resumed",
-                 "drain_handoffs")
+                 "drain_handoffs", "ctrl_reresolves")
 
 _lock = threading.Lock()
 _stats: Dict[str, float] = {k: 0.0 for k in COUNTER_NAMES}
@@ -64,6 +67,10 @@ def _counters():
                     "drain_handoffs",
                     "in-flight streams force-failed-over when a replica "
                     "drain hit its deadline"),
+                "ctrl_reresolves": Counter(
+                    "ctrl_reresolves",
+                    "ingress re-resolutions of the serve controller after "
+                    "failures (controller restarts ridden out)"),
             }
         except Exception:
             _user_counters = {}

@@ -26,6 +26,12 @@ Suppressions
 ``# rtlint: thread=exec``            annotation consumed by the
                                      cross-thread-state rule (marks a
                                      ``def`` as exec-thread-side)
+
+A directive on a comment-only line attaches to the next code line (so a
+justification block can precede the offending statement), and anything
+after the rule list — ``disable=rule - because ...`` — is justification
+text, ignored by the parser but required by convention: a suppression
+with no stated reason is a review comment waiting to happen.
 """
 
 from __future__ import annotations
@@ -139,12 +145,27 @@ class LintConfig:
         "_private/core_worker.py":
             r"^(resolve_args_fast|_resolve_inline|pack_return_sync"
             r"|_fast_dispatch)$",
+        # object-plane hot paths (ROADMAP item 3: the zero-pickle
+        # invariant follows the wire down into chunk push/pull + spill)
+        "_private/object_transfer.py":
+            r"^(push_object_chunks|fetch_object_into|read_spill_chunk"
+            r"|write_spill_file|read_spill_file)$",
+        "_private/raylet.py":
+            r"^(_h_fetch_object|_h_pull_object|_h_push_object"
+            r"|_h_receive_object_chunk)$",
+        # Dataset shuffle framing: shards move as raw blocks, never
+        # ad-hoc pickled by the shuffle plan itself
+        "data/push_shuffle.py":
+            r"^(push_based_shuffle|add|finalize|_split_block_even)$",
+        "data/dataset.py":
+            r"^(_shuffle_partition|_shuffle_merge|_merge_blocks_local)$",
     })
     # rule 3: call names treated as safe task-spawn helpers (they attach
     # the exception-logging done callback themselves).
     spawn_helpers: Tuple[str, ...] = ("spawn", "spawn_logged")
     # rule 5: directories (path fragments) where jit purity is enforced.
-    jit_dirs: Tuple[str, ...] = ("ops/", "models/", "autotune/")
+    jit_dirs: Tuple[str, ...] = ("ops/", "models/", "autotune/",
+                                 "train/", "parallel/")
     # rule 6: role -> path suffix for the metrics pipeline files.
     metrics_roles: Dict[str, str] = field(default_factory=lambda: {
         "node_stats": "_private/raylet.py",
@@ -157,25 +178,114 @@ class LintConfig:
         "timestamp", "load_avg", "mem_total", "mem_available",
         "object_store", "workers", "num_workers", "loop_lag_ms",
     )
+    # rule 7 (durable-write): files holding commit-protocol writers —
+    # every tmp-write + rename in them must follow tmp → fsync → rename,
+    # with the manifest/commit record written last.
+    durable_paths: Tuple[str, ...] = (
+        "train/_internal/checkpoint_store.py",
+        "train/jax/orbax_checkpoint.py",
+        "_private/object_transfer.py",
+        "_private/gcs.py",
+        "_private/daemon_main.py",
+        "autotune/cache.py",
+        "workflow/api.py",
+    )
+    # rule 8 (cancellation-safety): path fragments where swallowing
+    # CancelledError/Preempted/BaseException is flagged.
+    cancel_paths: Tuple[str, ...] = (
+        "_private/", "serve/", "train/", "util/", "dashboard/",
+    )
+    # rule 9 (resource-leak): paired acquire/release call specs.  ``alloc``
+    # and ``release`` are regexes matched against the full dotted call
+    # name; ``paths`` scopes which files are scanned for allocations
+    # (releases are matched project-wide so cross-module pairing works).
+    resource_pairs: Tuple[Dict[str, object], ...] = field(
+        default_factory=lambda: default_resource_pairs())
+    # rule 10 (knob-drift): doc files (relative to the lint root's parent,
+    # i.e. the repo root) that must agree with the RT_* knobs the code
+    # reads; internal plumbing vars the runtime sets for its own children
+    # are exempt.
+    knob_docs: Tuple[str, ...] = (
+        "docs/KNOBS.md", "docs/SERVE.md", "docs/TRAIN.md",
+        "docs/AUTOTUNE.md", "docs/LINT.md", "ARCHITECTURE.md",
+    )
+    knob_internal: Tuple[str, ...] = (
+        "RT_ADDRESS", "RT_GCS_ADDRESS", "RT_RAYLET_ADDRESS",
+        "RT_NODE_ID", "RT_WORKER_ID", "RT_STORE_NAME", "RT_LOG_DIR",
+        "RT_SESSION_DIR", "RT_RUNTIME_ENV", "RT_SYSTEM_CONFIG",
+        "RT_JOB_SUBMISSION_ID", "RT_CLIENT_SESSION_ID",
+        "RT_CLIENT_SESSION_GCS",
+    )
+    # suffix of the file whose defs/FaultSpec fields are the ground truth
+    # for fault-injection hook names.
+    fault_injection_path: str = "util/fault_injection.py"
+    # suffixes of the per-package counter-registry modules checked by the
+    # knob-drift bump audit (bump("x") must hit a registered counter).
+    counter_registries: Tuple[str, ...] = (
+        "serve/metrics.py", "train/metrics.py",
+    )
+
+
+def default_resource_pairs() -> Tuple[Dict[str, object], ...]:
+    """The runtime's paired-resource contracts (kept out of LintConfig's
+    dataclass default so tests can build small configs without them)."""
+    return (
+        {"name": "kv-pages",
+         "paths": ("serve/engine/",),
+         "alloc": r"\.alloc$",
+         "release": r"\.free$",
+         "what": "KV-cache pages"},
+        {"name": "plasma-buffer",
+         "paths": ("_private/plasma.py", "_private/raylet.py",
+                   "_private/core_worker.py"),
+         "alloc": r"(^|\.)(plasma\.create|_create_with_spill)$"
+                  r"|^self\.create$",
+         "release": r"\.(seal|delete|abort)$",
+         "what": "an unsealed plasma allocation"},
+        {"name": "stream-state",
+         "paths": ("_private/core_worker.py",),
+         "alloc": r"(^|\.)register_stream$",
+         "release": r"_streams\.pop$|(^|\.)cancel_stream$",
+         "what": "owner-side stream consumer state"},
+    )
 
 
 class Rule:
-    """Base: subclasses set ``name`` and override check / check_project."""
+    """Base: subclasses set ``name`` and override check / check_project.
+    ``index`` is the run's ProjectIndex (cross-module symbol/import table
+    + one-hop call resolution); it is always provided by lint_paths but
+    defaults to None so rules stay callable standalone in tests."""
 
     name = ""
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         return ()
 
-    def check_project(self, units: List[FileUnit], config: LintConfig
-                      ) -> Iterable[Finding]:
+    def check_project(self, units: List[FileUnit], config: LintConfig,
+                      index=None) -> Iterable[Finding]:
         return ()
+
+
+def _directive_rules(arg: str) -> Set[str]:
+    """Rule names from a directive argument.  Each comma-separated chunk
+    keeps only its first whitespace-delimited token, so justification
+    text after the rule list (``disable=rule - reason why``) is ignored."""
+    rules = set()
+    for chunk in arg.split(","):
+        parts = chunk.split()
+        if parts:
+            rules.add(parts[0])
+    return rules
 
 
 def _parse_directives(source: str, unit: FileUnit) -> None:
     """Scan comments via tokenize so strings containing 'rtlint:' don't
-    trigger; fills unit.line_suppress / file_suppress / thread_marks."""
+    trigger; fills unit.line_suppress / file_suppress / thread_marks.
+
+    A ``disable`` on a comment-only line attaches to the next code line
+    (skipping the rest of the comment block), so a multi-line
+    justification can sit above the statement it excuses."""
     try:
         tokens = tokenize.generate_tokens(StringIO(source).readline)
         for tok in tokens:
@@ -185,11 +295,20 @@ def _parse_directives(source: str, unit: FileUnit) -> None:
             if not m:
                 continue
             kind, arg = m.group(1), (m.group(2) or "").strip()
-            rules = {r.strip() for r in arg.split(",") if r.strip()} \
-                if arg else {"*"}
+            rules = _directive_rules(arg) if arg else {"*"}
             if kind == "disable":
-                unit.line_suppress.setdefault(
-                    tok.start[0], set()).update(rules)
+                line = tok.start[0]
+                stripped = unit.lines[line - 1].strip() \
+                    if line <= len(unit.lines) else ""
+                if stripped.startswith("#"):
+                    # Standalone comment: attach to the statement below.
+                    ln = line + 1
+                    while ln <= len(unit.lines) and (
+                            not unit.lines[ln - 1].strip()
+                            or unit.lines[ln - 1].lstrip().startswith("#")):
+                        ln += 1
+                    line = ln
+                unit.line_suppress.setdefault(line, set()).update(rules)
             elif kind == "disable-file":
                 unit.file_suppress.update(rules)
             elif kind == "thread":
@@ -243,15 +362,23 @@ def collect_files(paths: Iterable[str]) -> List[Tuple[str, str]]:
 
 def default_rules() -> List[Rule]:
     from ray_tpu.tools.rtlint.rules import (blocking_in_loop,
-                                            cross_thread_state, jit_purity,
-                                            metrics_consistency, orphan_task,
-                                            pickle_fast_lane)
+                                            cancellation_safety,
+                                            cross_thread_state,
+                                            durable_write, jit_purity,
+                                            knob_drift,
+                                            metrics_consistency,
+                                            orphan_task, pickle_fast_lane,
+                                            resource_leak)
     return [blocking_in_loop.BlockingInLoop(),
             pickle_fast_lane.PickleFastLane(),
             orphan_task.OrphanTask(),
             cross_thread_state.CrossThreadState(),
             jit_purity.JitPurity(),
-            metrics_consistency.MetricsConsistency()]
+            metrics_consistency.MetricsConsistency(),
+            durable_write.DurableWrite(),
+            cancellation_safety.CancellationSafety(),
+            resource_leak.ResourceLeak(),
+            knob_drift.KnobDrift()]
 
 
 @dataclass
@@ -298,13 +425,16 @@ def lint_paths(paths: Iterable[str], *,
             continue
         units.append(unit)
 
+    from ray_tpu.tools.rtlint.index import ProjectIndex
+    index = ProjectIndex(units)
+
     raw: List[Finding] = []
     for rule in rules:
         for unit in units:
-            for f in rule.check(unit, config):
+            for f in rule.check(unit, config, index):
                 if not unit.suppressed(f.rule, f.line, f.end_line):
                     raw.append(f)
-        for f in rule.check_project(units, config):
+        for f in rule.check_project(units, config, index):
             unit = next((u for u in units if u.path == f.path), None)
             if unit is None or not unit.suppressed(f.rule, f.line,
                                                    f.end_line):

@@ -1,0 +1,252 @@
+"""Project index: cross-module symbol resolution for rtlint rules.
+
+PR 10's rules reasoned about one file at a time (the blocking-in-loop
+rule expanded one call level, but only into *same-file* sync helpers).
+The invariants added since then are cross-module by nature: a KV page
+allocated in ``serve/engine/engine.py`` is freed by the ingress, a
+checkpoint shard written in ``orbax_checkpoint.py`` is made durable by a
+helper imported from ``checkpoint_store.py``, and a fault hook called in
+``raylet.py`` must exist in ``util/fault_injection.py``.  The index
+gives every rule the one-hop reasoning those invariants need — still
+pure ``ast`` over the already-parsed FileUnits, never importing lintees.
+
+What it holds
+-------------
+- a **module map**: dotted module name (derived from the reported path)
+  → FileUnit, with suffix matching so fixture trees (``proj/a.py`` ↔
+  module ``a``) resolve the same way the real package does;
+- a **symbol table** per unit: qualified name → def node for every
+  function/method/class;
+- an **import table** per unit: local binding → (module, attr) for
+  ``import x``, ``import x as y``, ``from x import a as b``;
+- a lazy **call resolver**: ``resolve_call(unit, call)`` maps a Call
+  node to the (unit, def) it lands on — local defs, ``self.``/``cls.``
+  methods (including single-level inheritance within the project), and
+  imported names, one hop across modules.
+
+Resolution is deliberately best-effort: a miss returns ``None`` and the
+rule falls back to same-file behavior.  Soundness lives in the rules'
+direction of use — they only *excuse* a finding on a successful resolve
+(a helper proven to fsync, a release proven to exist), or *raise* one on
+a proven-impossible target (a fault hook that does not exist), never the
+other way around.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.rtlint.engine import FileUnit, dotted_name
+
+DefNode = ast.AST  # FunctionDef | AsyncFunctionDef | ClassDef
+
+
+@dataclass(frozen=True)
+class Resolved:
+    """One resolved callee: where it lives and what it is."""
+
+    unit: FileUnit
+    node: DefNode
+    qualname: str
+
+    @property
+    def is_function(self) -> bool:
+        return isinstance(self.node, (ast.FunctionDef, ast.AsyncFunctionDef))
+
+
+def _module_of(path: str) -> str:
+    """'ray_tpu/util/state.py' -> 'ray_tpu.util.state';
+    '__init__.py' maps to its package."""
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class ProjectIndex:
+    """Symbol/import index + one-hop call resolution over a lint run."""
+
+    def __init__(self, units: List[FileUnit]):
+        self.units = units
+        # dotted module -> unit (full reported path, e.g. ray_tpu.util.state)
+        self._modules: Dict[str, FileUnit] = {}
+        # unit.path -> {qualname -> def node}
+        self._defs: Dict[str, Dict[str, DefNode]] = {}
+        # unit.path -> {class name -> ClassDef}
+        self._classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        # unit.path -> {local name -> (module, attr-or-None)}
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        for unit in units:
+            self._modules[_module_of(unit.path)] = unit
+            self._index_unit(unit)
+
+    # ------------------------------------------------------------ building
+
+    def _index_unit(self, unit: FileUnit) -> None:
+        defs: Dict[str, DefNode] = {}
+        classes: Dict[str, ast.ClassDef] = {}
+        imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = self._qualname(unit, node)
+                defs.setdefault(qual, node)
+                # bare name too, first definition wins (module-level defs
+                # shadow same-named methods only when no class qualifies)
+                defs.setdefault(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                classes.setdefault(node.name, node)
+                defs.setdefault(node.name, node)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".", 1)[0]] = (
+                        alias.name, None)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                prefix = "." * node.level
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = (
+                        prefix + node.module, alias.name)
+        self._defs[unit.path] = defs
+        self._classes[unit.path] = classes
+        self._imports[unit.path] = imports
+
+    @staticmethod
+    def _qualname(unit: FileUnit, node: ast.AST) -> str:
+        names = [getattr(node, "name", "")]
+        cur = unit.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = unit.parents.get(cur)
+        return ".".join(reversed(names))
+
+    # ------------------------------------------------------------- lookups
+
+    def unit_for_module(self, modname: str) -> Optional[FileUnit]:
+        """Resolve a dotted module name to a unit, tolerating the reported
+        paths being rooted at the lint argument's basename: ``util.state``
+        matches ``ray_tpu/util/state.py`` (dotted-suffix match on a module
+        boundary).  Relative imports (leading dots) are matched by their
+        trailing segments the same way."""
+        modname = modname.lstrip(".")
+        if not modname:
+            return None
+        hit = self._modules.get(modname)
+        if hit is not None:
+            return hit
+        suffix = "." + modname
+        for full, unit in self._modules.items():
+            if full.endswith(suffix):
+                return unit
+        return None
+
+    def defs_in(self, unit: FileUnit) -> Dict[str, DefNode]:
+        return self._defs.get(unit.path, {})
+
+    def lookup(self, unit: FileUnit, name: str) -> Optional[Resolved]:
+        """Resolve a bare or dotted name visible in ``unit`` to its def:
+        local defs first, then imported names one hop across modules."""
+        defs = self._defs.get(unit.path, {})
+        if name in defs:
+            return Resolved(unit, defs[name], name)
+        imports = self._imports.get(unit.path, {})
+        head, _, rest = name.partition(".")
+        if head in imports:
+            mod, attr = imports[head]
+            if attr is not None and not rest:
+                # from mod import attr [as head]
+                target = self.unit_for_module(mod)
+                if target is not None:
+                    tdefs = self._defs.get(target.path, {})
+                    if attr in tdefs:
+                        return Resolved(target, tdefs[attr], attr)
+                # from pkg import submodule: attr may itself be a module
+                sub = self.unit_for_module(mod + "." + attr)
+                if sub is not None:
+                    return None
+            elif rest:
+                # import mod [as head]; head.rest — or
+                # from pkg import submod: submod.rest
+                base = mod if attr is None else mod + "." + attr
+                target = self.unit_for_module(base)
+                if target is None and attr is None:
+                    target = self.unit_for_module(mod)
+                if target is not None:
+                    tdefs = self._defs.get(target.path, {})
+                    if rest in tdefs:
+                        return Resolved(target, tdefs[rest], rest)
+        return None
+
+    def enclosing_class(self, unit: FileUnit,
+                        node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = unit.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = unit.parents.get(cur)
+        return None
+
+    def method_on(self, unit: FileUnit, cls: ast.ClassDef,
+                  name: str) -> Optional[Resolved]:
+        """``name`` on ``cls`` or (one hop) a base class resolvable in the
+        project — single-level inheritance is all the runtime uses."""
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == name:
+                return Resolved(unit, stmt, f"{cls.name}.{name}")
+        for base in cls.bases:
+            base_name = dotted_name(base)
+            if not base_name:
+                continue
+            res = self.lookup(unit, base_name)
+            if res is not None and isinstance(res.node, ast.ClassDef):
+                for stmt in res.node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name == name:
+                        return Resolved(res.unit, stmt,
+                                        f"{res.node.name}.{name}")
+        return None
+
+    def resolve_call(self, unit: FileUnit,
+                     call: ast.Call) -> Optional[Resolved]:
+        """Map a Call to the def it lands on, one hop across modules.
+        Handles ``foo()``, ``mod.foo()``, ``self.meth()`` /
+        ``cls.meth()`` (with single-level project-local inheritance).
+        Returns None for anything it cannot prove."""
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        if name.startswith(("self.", "cls.")) and name.count(".") == 1:
+            cls = self.enclosing_class(unit, call)
+            if cls is None:
+                return None
+            return self.method_on(unit, cls, name.split(".", 1)[1])
+        return self.lookup(unit, name)
+
+    # -------------------------------------------------------- conveniences
+
+    def function_calls(self, node: ast.AST, *, into_nested: bool = True
+                       ) -> Iterable[ast.Call]:
+        stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and not into_nested:
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def body_contains_call(self, res: Resolved, leaves: Set[str]) -> bool:
+        """True when the resolved function's body (including nested defs)
+        contains a call whose dotted-name leaf is in ``leaves``."""
+        if not res.is_function:
+            return False
+        for call in self.function_calls(res.node):
+            name = dotted_name(call.func)
+            if name and name.rsplit(".", 1)[-1] in leaves:
+                return True
+        return False

@@ -9,11 +9,15 @@ rule is the static counterpart: it walks each async function body
 (without descending into nested defs/lambdas, which are typically
 executor or thread targets) and flags known-blocking calls.
 
-It also expands one call level: a call to a *sync* method/function
-defined in the same file is scanned for the same blocking calls, and a
-hit is reported at the async call site ("via _collect_node_stats: ...").
-That catches the common pattern of an async loop delegating to a sync
-helper that quietly does file IO.
+It also expands one call level: a call to a *sync* method/function is
+scanned for the same blocking calls, and a hit is reported at the async
+call site ("via _collect_node_stats: ...").  Same-file helpers resolve
+through the local def table as before; with the project index the
+expansion now follows the call one hop **across modules** too —
+``self.meth()`` through single-level inheritance, ``helper()`` imported
+with ``from x import helper``, and ``mod.helper()`` — so an async loop
+delegating to a sync helper that moved to another file no longer goes
+dark.
 
 In loop-critical modules (``config.loop_critical_suffixes``) the rule
 additionally flags ``cloudpickle.dumps/loads`` on the loop — closure and
@@ -75,8 +79,8 @@ def _sync_defs(unit: FileUnit) -> Dict[Tuple[str, str], ast.FunctionDef]:
 class BlockingInLoop(Rule):
     name = "blocking-in-loop"
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         loop_critical = any(unit.path.endswith(sfx)
                             for sfx in config.loop_critical_suffixes)
         sync_defs = _sync_defs(unit)
@@ -93,15 +97,25 @@ class BlockingInLoop(Rule):
                 if reason is not None:
                     yield self._finding(unit, call, reason)
                     continue
-                # one-level expansion into same-file sync helpers
+                # one-level expansion: same-file sync helpers first, then
+                # one hop across modules through the project index.
                 target = self._resolve_local(name, cls, sync_defs)
+                where = ""
+                if target is None and index is not None:
+                    res = index.resolve_call(unit, call)
+                    if res is not None and \
+                            isinstance(res.node, ast.FunctionDef):
+                        target, where = res.node, res.unit.path
+                        if where == unit.path:
+                            where = ""
                 if target is None:
                     continue
                 inner = self._first_blocking(target, loop_critical)
                 if inner is not None:
+                    via = f" in {where}" if where else ""
                     yield self._finding(
                         unit, call,
-                        f"calls {name}() which does {inner} "
+                        f"calls {name}() which does {inner}{via} "
                         "(sync helper invoked from an async body)")
 
     def _resolve_local(self, name: str, cls: str,

@@ -1,0 +1,147 @@
+"""Rule 8 — cancellation-safety.
+
+On Python 3.10 ``asyncio.CancelledError`` derives from ``BaseException``,
+and PR 12 made ``Preempted`` do the same on purpose: neither should be
+stopped by the ``except Exception`` walls on task boundaries.  The
+remaining way to break cancellation is to catch them *explicitly* and
+not re-raise — a bare ``except:``, an ``except BaseException:`` used as
+a catch-all, or an except clause that lumps ``CancelledError`` /
+``Preempted`` in with operational errors and converts the cancel into a
+retry.  A swallowed cancel turns ``asyncio.wait_for`` timeouts into
+hangs and preemption drills into zombie workers.
+
+The rule scans every except handler on the runtime paths
+(``config.cancel_paths``) and flags handlers that catch a cancellation
+type (or everything) without any ``raise`` in the body.  Exemptions,
+in decreasing order of certainty:
+
+- any ``raise`` statement in the handler (conditional re-raise counts —
+  the ``Task.cancelling()`` dance in protocol.py is the canonical one);
+- a terminal call (``os._exit`` / ``sys.exit``): process is ending, as
+  in the forkserver child's crash barrier;
+- the *reaper* pattern for pure-cancellation handlers: a function that
+  itself calls ``.cancel()`` may swallow the resulting
+  ``CancelledError`` when awaiting the task it just cancelled — that is
+  the documented way to reap, not a swallow of an external cancel.
+  Mixed handlers (cancel type + operational errors in one tuple) never
+  get this exemption: sharing a handler means the cancel is being
+  *converted*, which is exactly the bug.
+
+Deliberate conversion sites (e.g. a worker turning ``Preempted`` into a
+checkpoint-then-exit) carry an inline
+``# rtlint: disable=cancellation-safety`` with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name)
+
+_CANCEL_LEAVES = {"CancelledError", "Preempted"}
+_TERMINAL_LEAVES = {"_exit", "exit", "abort"}
+_TERMINAL_HEADS = {"os", "_os", "sys", "_sys"}
+
+
+def _leaf(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _caught(handler: ast.ExceptHandler) -> Optional[List[str]]:
+    """Dotted names of caught exception types; None for a bare except."""
+    t = handler.type
+    if t is None:
+        return None
+    if isinstance(t, ast.Tuple):
+        return [dotted_name(e) for e in t.elts]
+    return [dotted_name(t)]
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _has_terminal_call(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if "." in name and name.split(".", 1)[0] in _TERMINAL_HEADS \
+                    and _leaf(name) in _TERMINAL_LEAVES:
+                return True
+    return False
+
+
+def _enclosing_function(unit: FileUnit, node: ast.AST) -> Optional[ast.AST]:
+    cur = unit.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = unit.parents.get(cur)
+    return None
+
+
+def _function_cancels(fn: ast.AST) -> bool:
+    """True when the function calls ``<something>.cancel()`` — the reaper
+    pattern's tell."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if name.endswith(".cancel") or name == "cancel":
+                return True
+    return False
+
+
+class CancellationSafety(Rule):
+    name = "cancellation-safety"
+
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
+        if not any(frag in unit.path for frag in config.cancel_paths):
+            return
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            f = self._check_handler(unit, node)
+            if f is not None:
+                yield f
+
+    def _check_handler(self, unit: FileUnit,
+                       handler: ast.ExceptHandler) -> Optional[Finding]:
+        names = _caught(handler)
+        bare = names is None
+        names = names or []
+        catches_base = any(_leaf(n) == "BaseException" for n in names)
+        cancel_names = [n for n in names if _leaf(n) in _CANCEL_LEAVES]
+        if not (bare or catches_base or cancel_names):
+            return None
+        if _has_raise(handler) or _has_terminal_call(handler):
+            return None
+        pure_cancel = bool(cancel_names) and len(cancel_names) == len(names)
+        if pure_cancel:
+            fn = _enclosing_function(unit, handler)
+            if fn is not None and _function_cancels(fn):
+                return None  # reaping a task this function cancelled
+            what = " / ".join(_leaf(n) for n in cancel_names)
+            msg = (f"swallows {what} without re-raising — breaks external "
+                   "cancellation; re-raise, or this must be the reap of a "
+                   "task this function cancelled")
+        elif bare:
+            msg = ("bare `except:` without re-raise swallows CancelledError"
+                   "/Preempted (both BaseException) — re-raise or narrow "
+                   "to Exception")
+        elif catches_base:
+            msg = ("`except BaseException` without re-raise swallows "
+                   "cancellation/preemption — re-raise or narrow to "
+                   "Exception")
+        else:
+            what = " / ".join(_leaf(n) for n in cancel_names)
+            msg = (f"catches {what} together with operational errors and "
+                   "does not re-raise — an external cancel is silently "
+                   "converted into the error-recovery path")
+        return Finding(rule=self.name, path=unit.path, line=handler.lineno,
+                       col=handler.col_offset, message=msg,
+                       scope=unit.scope_of(handler),
+                       source=unit.source_line(handler.lineno),
+                       end_line=handler.lineno)

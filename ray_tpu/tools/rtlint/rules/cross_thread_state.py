@@ -72,8 +72,8 @@ def _self_attr_writes(fn: ast.AST) -> List[Tuple[str, ast.AST, bool]]:
 class CrossThreadState(Rule):
     name = "cross-thread-state"
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         for cls in ast.walk(unit.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue

@@ -1,0 +1,200 @@
+"""Rule 7 — durable-write (crash-consistent commit ordering).
+
+PR 12's checkpoint store and PR 11's spill writer converged on one
+commit protocol: write into a temporary sibling, ``fsync`` the file,
+``os.replace`` it into place (then fsync the parent directory), and
+write the manifest/commit record **last** so a crash at any point leaves
+either the previous generation or an ignorable orphan — never a torn
+file at the committed path.  This rule is the static form of that
+protocol for the writer modules listed in ``config.durable_paths``:
+
+- an ``os.replace``/``os.rename`` that publishes data written in the
+  same function without an intervening ``fsync`` is flagged (the rename
+  can commit a torn/empty file: the metadata reaches disk before the
+  data does);
+- a manifest/commit-record write followed by further data writes in the
+  same function is flagged (the record would attest to files that may
+  never land).
+
+The project index widens both checks one hop: a call to a helper whose
+body provably fsyncs (``write_file_durable``-style, including a helper
+that itself delegates one more level) counts as the fsync/commit event
+at the call site, so correct code that factors the pattern into shared
+helpers lints clean without annotations.
+
+The rule only reasons within one function (plus the one resolved hop) —
+a function that renames data fsynced by its *caller* (e.g. a publish
+helper) has no write event in scope and is deliberately not flagged;
+the check lands where write and rename meet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name, iter_body_calls)
+
+_WRITE_FLAGS = {"O_WRONLY", "O_RDWR", "O_APPEND", "O_CREAT", "O_TRUNC"}
+_FSYNC_LEAVES = {"fsync", "fdatasync"}
+_RENAME_LEAVES = {"replace", "rename", "renames", "move"}
+# module heads under which replace/rename/fsync are the filesystem calls
+# (and not e.g. str.replace); covers the repo's `import os as _os` idiom.
+_FS_HEADS = {"os", "_os", "shutil"}
+
+
+def _direct_kind(name: str) -> Optional[str]:
+    """'fsync' / 'rename' for direct filesystem calls, else None."""
+    if "." not in name:
+        return None
+    head = name.split(".", 1)[0]
+    leaf = name.rsplit(".", 1)[-1]
+    if head in _FS_HEADS and leaf in _FSYNC_LEAVES:
+        return "fsync"
+    if head in _FS_HEADS and leaf in _RENAME_LEAVES:
+        return "rename"
+    return None
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    """open()/os.open() that can create or modify a file."""
+    name = dotted_name(call.func)
+    if not name or name.rsplit(".", 1)[-1] != "open":
+        return False
+    mode = None
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode is None and len(call.args) >= 2 \
+            and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        mode = call.args[1].value
+    if mode is not None:
+        return any(c in mode for c in "wax+")
+    # os.open(path, flags) form
+    if len(call.args) >= 2:
+        for sub in ast.walk(call.args[1]):
+            if isinstance(sub, ast.Attribute) and sub.attr in _WRITE_FLAGS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in _WRITE_FLAGS:
+                return True
+    return False
+
+
+def _mentions_manifest(call: ast.Call) -> bool:
+    """Heuristic: the call's arguments name a manifest/commit record."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                    and "manifest" in sub.value.lower():
+                return True
+            if isinstance(sub, ast.Name) and "manifest" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and "manifest" in sub.attr.lower():
+                return True
+    return False
+
+
+def _helper_kinds(res, index, depth: int = 0) -> Set[str]:
+    """Filesystem event kinds a resolved helper's body provably performs,
+    following same-resolution one more level so ``write_json_durable ->
+    write_file_durable -> os.fsync`` still registers."""
+    kinds: Set[str] = set()
+    if not res.is_function:
+        return kinds
+    for sub in ast.walk(res.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        k = _direct_kind(dotted_name(sub.func))
+        if k:
+            kinds.add(k)
+        elif depth < 1 and index is not None:
+            inner = index.resolve_call(res.unit, sub)
+            if inner is not None and inner.node is not res.node:
+                kinds |= _helper_kinds(inner, index, depth + 1)
+    return kinds
+
+
+class DurableWrite(Rule):
+    name = "durable-write"
+
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
+        if not any(unit.path.endswith(sfx) for sfx in config.durable_paths):
+            return
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(unit, node, config, index)
+
+    def _check_fn(self, unit: FileUnit, fn: ast.AST, config: LintConfig,
+                  index) -> Iterable[Finding]:
+        # (line, kind, call): kind in write | fsync | rename | durable
+        events: List[Tuple[int, str, ast.Call]] = []
+        for call in iter_body_calls(fn):
+            name = dotted_name(call.func)
+            if not name:
+                continue
+            kind = _direct_kind(name)
+            if kind is None and _is_write_open(call):
+                kind = "write"
+            if kind is None and index is not None:
+                res = index.resolve_call(unit, call)
+                if res is not None and res.node is not fn:
+                    kinds = _helper_kinds(res, index)
+                    if "fsync" in kinds and "rename" in kinds:
+                        kind = "durable"   # helper does the whole pattern
+                    elif "fsync" in kinds:
+                        kind = "fsync"
+                    elif "rename" in kinds:
+                        kind = "rename"
+            if kind is not None:
+                events.append((call.lineno, kind, call))
+        if not events:
+            return
+        events.sort(key=lambda e: e[0])
+
+        # 1. rename publishing same-function writes without fsync between
+        for line, kind, call in events:
+            if kind != "rename":
+                continue
+            writes = [e[0] for e in events if e[1] == "write" and e[0] < line]
+            if not writes:
+                continue
+            last_write = max(writes)
+            synced = any(e[1] in ("fsync", "durable")
+                         and last_write <= e[0] <= line for e in events)
+            if not synced:
+                yield self._finding(
+                    unit, call,
+                    f"rename publishes data written at line {last_write} "
+                    "with no fsync in between — a crash can commit a "
+                    "torn/empty file (tmp -> fsync -> os.replace; see "
+                    "checkpoint_store.write_file_durable)")
+
+        # 2. manifest/commit record must be the LAST durable write
+        writes = [e for e in events if e[1] in ("write", "durable")]
+        manifest = [e for e in writes if _mentions_manifest(e[2])]
+        if manifest:
+            first_manifest = min(e[0] for e in manifest)
+            later = [e for e in writes
+                     if e[0] > first_manifest and not _mentions_manifest(e[2])]
+            if later:
+                _, _, call = next(e for e in manifest
+                                  if e[0] == first_manifest)
+                yield self._finding(
+                    unit, call,
+                    "manifest/commit record written before the data write "
+                    f"at line {later[0][0]} — the commit record must be "
+                    "the last durable write, or a crash publishes a "
+                    "manifest attesting to files that never landed")
+
+    def _finding(self, unit: FileUnit, call: ast.Call,
+                 message: str) -> Finding:
+        return Finding(rule=self.name, path=unit.path, line=call.lineno,
+                       col=call.col_offset, message=message,
+                       scope=unit.scope_of(call),
+                       source=unit.source_line(call.lineno),
+                       end_line=getattr(call, "end_lineno", 0) or 0)

@@ -89,8 +89,8 @@ def _collect_jitted(unit: FileUnit) -> Set[ast.AST]:
 class JitPurity(Rule):
     name = "jit-purity"
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         if not any(frag in unit.path for frag in config.jit_dirs):
             return
         for fn in sorted(_collect_jitted(unit), key=lambda n: n.lineno):

@@ -1,0 +1,323 @@
+"""Rule 10 — knob-drift (config surface vs. docs vs. plumbing).
+
+Three drift surfaces, all of which have bitten in past PRs and none of
+which a single-file rule can see:
+
+1. **Env knobs <-> docs.**  Every ``RT_*`` environment variable the code
+   reads must appear in at least one ops doc (``config.knob_docs``), and
+   every ``RT_*`` token the docs mention must exist somewhere in the
+   code — a knob documented but never read is a lie, a knob read but
+   never documented is undiscoverable.  Internal plumbing vars the
+   runtime sets for its own children (``config.knob_internal``) are
+   exempt, as are reads through a variable (the reverse direction then
+   matches any ``RT_*`` string constant, so ``ENV_VAR =
+   "RT_FAULT_INJECTION"`` indirection still counts as implemented).
+   Docs may write a trailing ``*`` for a knob family (``RT_CHAOS_*``).
+
+2. **Fault-injection hooks.**  Chaos tests and runtime call sites name
+   hooks on ``util/fault_injection.py`` (attribute calls on the
+   imported module, ``from ... import name``, and ``FaultSpec(...)``
+   keywords).  A renamed hook silently turns a chaos test into a no-op
+   — the test passes because the fault never fires.  Every referenced
+   name must exist in the ground-truth module.
+
+3. **Counter chain.**  ``serve/metrics.py`` and ``train/metrics.py``
+   register counters in ``COUNTER_NAMES``; the raylet merges their
+   ``stats()`` into node stats, the GCS folds node stats through
+   ``_FOLDED_COUNTERS``, and the dashboard serves the fold.  That chain
+   is dynamic (dict merges), so metrics-consistency's key-literal check
+   cannot follow it.  This audit closes the gap statically: every
+   ``bump("x")`` in a package must name a registered counter, and every
+   registered counter must appear in the GCS fold list — otherwise the
+   increment is dropped before ``/api/metrics`` and dashboards read 0
+   forever.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name)
+
+_KNOB_RE = re.compile(r"RT_[A-Z0-9_]+\*?")
+
+
+def _leaf(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _find(path: str, line: int, message: str, scope: str = "",
+          source: str = "") -> Finding:
+    return Finding(rule="knob-drift", path=path, line=line, col=0,
+                   message=message, scope=scope, source=source,
+                   end_line=line)
+
+
+def _repo_root(units: List[FileUnit]) -> Optional[str]:
+    """Directory the reported paths are relative to (the lint arg's
+    parent), recovered by peeling a unit's rel path off its abspath."""
+    for u in units:
+        ab = u.abspath.replace(os.sep, "/")
+        if ab.endswith("/" + u.path):
+            return ab[: -(len(u.path) + 1)]
+    return None
+
+
+class KnobDrift(Rule):
+    name = "knob-drift"
+
+    def check_project(self, units: List[FileUnit], config: LintConfig,
+                      index=None) -> Iterable[Finding]:
+        yield from self._knobs_vs_docs(units, config)
+        yield from self._fault_hooks(units, config)
+        yield from self._counter_chain(units, config)
+
+    # ------------------------------------------------- 1. knobs vs docs
+
+    def _env_reads(self, units: List[FileUnit]
+                   ) -> Dict[str, Tuple[FileUnit, int]]:
+        reads: Dict[str, Tuple[FileUnit, int]] = {}
+
+        def note(value: object, unit: FileUnit, line: int) -> None:
+            if isinstance(value, str) and value.startswith("RT_"):
+                reads.setdefault(value, (unit, line))
+
+        for unit in units:
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name and (name.endswith("environ.get")
+                                 or _leaf(name) == "getenv") and node.args:
+                        a = node.args[0]
+                        if isinstance(a, ast.Constant):
+                            note(a.value, unit, node.lineno)
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, ast.Load):
+                    if dotted_name(node.value).endswith("environ") \
+                            and isinstance(node.slice, ast.Constant):
+                        note(node.slice.value, unit, node.lineno)
+        return reads
+
+    @staticmethod
+    def _rt_string_constants(units: List[FileUnit]) -> Set[str]:
+        out: Set[str] = set()
+        for unit in units:
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value.startswith("RT_"):
+                    out.add(node.value)
+                elif isinstance(node, ast.Name) \
+                        and node.id.startswith("RT_"):
+                    out.add(node.id)
+        return out
+
+    def _knobs_vs_docs(self, units: List[FileUnit],
+                       config: LintConfig) -> Iterable[Finding]:
+        root = _repo_root(units)
+        if root is None:
+            return
+        # token -> (docpath, line, stripped source line); first occurrence
+        doc_tokens: Dict[str, Tuple[str, int, str]] = {}
+        any_doc = False
+        for rel in config.knob_docs:
+            full = os.path.join(root, rel)
+            try:
+                with open(full, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            any_doc = True
+            for i, line in enumerate(text.splitlines(), 1):
+                for m in _KNOB_RE.finditer(line):
+                    doc_tokens.setdefault(m.group(0),
+                                          (rel, i, line.strip()))
+        if not any_doc:
+            return
+        internal = set(config.knob_internal)
+        reads = self._env_reads(units)
+        consts = self._rt_string_constants(units)
+
+        def documented(knob: str) -> bool:
+            for tok in doc_tokens:
+                if tok.endswith("*"):
+                    if knob.startswith(tok[:-1]):
+                        return True
+                elif tok == knob:
+                    return True
+            return False
+
+        for knob in sorted(reads):
+            if knob in internal or documented(knob):
+                continue
+            unit, line = reads[knob]
+            yield _find(unit.path, line,
+                        f"env knob {knob} is read here but documented in "
+                        f"none of: {', '.join(config.knob_docs)}",
+                        scope="", source=unit.source_line(line))
+        for tok in sorted(doc_tokens):
+            plain = tok[:-1] if tok.endswith("*") else tok
+            if plain in internal:
+                continue
+            if tok.endswith("*"):
+                implemented = any(c.startswith(plain) for c in consts) \
+                    or any(r.startswith(plain) for r in reads)
+            else:
+                # a constant *starting with* the token also counts
+                # (e.g. doc says RT_MANIFEST, code has "RT_MANIFEST.json")
+                implemented = any(c.startswith(plain) for c in consts)
+            if not implemented:
+                rel, line, src = doc_tokens[tok]
+                yield _find(rel, line,
+                            f"documented knob {tok} does not appear "
+                            "anywhere in the code — stale doc or missing "
+                            "implementation", source=src)
+
+    # ---------------------------------------------- 2. fault-injection
+
+    def _fault_hooks(self, units: List[FileUnit],
+                     config: LintConfig) -> Iterable[Finding]:
+        ground = next((u for u in units
+                       if u.path.endswith(config.fault_injection_path)),
+                      None)
+        if ground is None:
+            return
+        names: Set[str] = set()
+        spec_fields: Set[str] = set()
+        for node in ground.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                names.add(node.name)
+                if isinstance(node, ast.ClassDef) \
+                        and node.name == "FaultSpec":
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) \
+                                and isinstance(stmt.target, ast.Name):
+                            spec_fields.add(stmt.target.id)
+                        elif isinstance(stmt, ast.Assign):
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    names.add(t.id)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        mod_leaf = config.fault_injection_path.rsplit("/", 1)[-1][:-3]
+        for unit in units:
+            if unit is ground:
+                continue
+            aliases: Set[str] = set()
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.split(".")[-1] == mod_leaf:
+                            aliases.add(a.asname
+                                        or a.name.split(".", 1)[0])
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    if node.module.split(".")[-1] == mod_leaf:
+                        for a in node.names:
+                            if a.name != "*" and a.name not in names:
+                                yield _find(
+                                    unit.path, node.lineno,
+                                    f"imports '{a.name}' from "
+                                    f"{config.fault_injection_path}, which "
+                                    "defines no such hook — the fault "
+                                    "would silently never fire",
+                                    source=unit.source_line(node.lineno))
+                    else:
+                        for a in node.names:
+                            if a.name == mod_leaf:
+                                aliases.add(a.asname or a.name)
+            for node in ast.walk(unit.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if _leaf(name) == "FaultSpec":
+                    for kw in node.keywords:
+                        if kw.arg is not None \
+                                and kw.arg not in spec_fields:
+                            yield _find(
+                                unit.path, node.lineno,
+                                f"FaultSpec has no field '{kw.arg}' — "
+                                "this fault config is silently ignored",
+                                scope=unit.scope_of(node),
+                                source=unit.source_line(node.lineno))
+                    continue
+                if "." not in name:
+                    continue
+                head, hook = name.split(".", 1)[0], _leaf(name)
+                via_alias = head in aliases and name.count(".") == 1
+                via_path = f"{mod_leaf}." in name and \
+                    name.split(f"{mod_leaf}.", 1)[1] == hook
+                if (via_alias or via_path) and hook not in names:
+                    yield _find(
+                        unit.path, node.lineno,
+                        f"fault-injection hook '{hook}' does not exist in "
+                        f"{config.fault_injection_path} — the chaos "
+                        "scenario calling it is a silent no-op",
+                        scope=unit.scope_of(node),
+                        source=unit.source_line(node.lineno))
+
+    # -------------------------------------------------- 3. counter chain
+
+    @staticmethod
+    def _name_tuple(unit: FileUnit, var: str) -> Tuple[Set[str], int]:
+        for node in unit.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == var
+                    for t in node.targets):
+                vals = {n.value for n in ast.walk(node.value)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)}
+                return vals, node.lineno
+        return set(), 0
+
+    def _counter_chain(self, units: List[FileUnit],
+                       config: LintConfig) -> Iterable[Finding]:
+        fold_sfx = config.metrics_roles.get("fold", "_private/gcs.py")
+        fold_unit = next((u for u in units if u.path.endswith(fold_sfx)),
+                         None)
+        folded: Set[str] = set()
+        if fold_unit is not None:
+            folded, _ = self._name_tuple(fold_unit, "_FOLDED_COUNTERS")
+        for reg_sfx in config.counter_registries:
+            reg = next((u for u in units if u.path.endswith(reg_sfx)), None)
+            if reg is None:
+                continue
+            counters, reg_line = self._name_tuple(reg, "COUNTER_NAMES")
+            if not counters:
+                continue
+            pkg = reg.path.rsplit("/", 1)[0] + "/"
+            # 3a: every bump("x") in the package names a registered counter
+            for unit in units:
+                if not unit.path.startswith(pkg):
+                    continue
+                for node in ast.walk(unit.tree):
+                    if isinstance(node, ast.Call) \
+                            and _leaf(dotted_name(node.func)) == "bump" \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        cname = node.args[0].value
+                        if cname not in counters:
+                            yield _find(
+                                unit.path, node.lineno,
+                                f"bump('{cname}') names a counter not in "
+                                f"{reg.path} COUNTER_NAMES — the "
+                                "increment never reaches node stats",
+                                scope=unit.scope_of(node),
+                                source=unit.source_line(node.lineno))
+            # 3b: every registered counter survives the GCS fold
+            if fold_unit is not None and folded:
+                for cname in sorted(counters - folded):
+                    yield _find(
+                        reg.path, reg_line,
+                        f"counter '{cname}' in COUNTER_NAMES never "
+                        f"appears in {fold_unit.path} _FOLDED_COUNTERS — "
+                        "its increments are dropped before /api/metrics",
+                        source=reg.source_line(reg_line))

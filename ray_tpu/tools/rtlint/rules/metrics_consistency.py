@@ -84,8 +84,8 @@ def _folded_counters(unit: FileUnit) -> Tuple[Set[str], int]:
 class MetricsConsistency(Rule):
     name = "metrics-consistency"
 
-    def check_project(self, units: List[FileUnit], config: LintConfig
-                      ) -> Iterable[Finding]:
+    def check_project(self, units: List[FileUnit], config: LintConfig,
+                      index=None) -> Iterable[Finding]:
         roles: Dict[str, Optional[FileUnit]] = {}
         for role, sfx in config.metrics_roles.items():
             roles[role] = next(

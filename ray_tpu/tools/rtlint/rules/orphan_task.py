@@ -40,8 +40,8 @@ def _async_def_names(unit: FileUnit) -> Set[str]:
 class OrphanTask(Rule):
     name = "orphan-task"
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         async_names = _async_def_names(unit)
         for node in ast.walk(unit.tree):
             if not isinstance(node, ast.Expr):

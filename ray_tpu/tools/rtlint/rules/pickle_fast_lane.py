@@ -13,9 +13,13 @@ escape hatch, not an ad-hoc ``pickle.dumps``).
 
 The rule is config-driven: ``config.fast_lane`` maps a path suffix to a
 regex over function names; any pickle/cloudpickle/marshal call inside a
-matching function is flagged.  ``wire.py`` itself is deliberately
-absent from the default config — its pickle fallback is the designed,
-counted escape hatch."""
+matching function is flagged.  With the project index the check also
+expands one call level: a fast-lane function delegating to a (possibly
+cross-module) sync helper that pickles is flagged at the call site —
+moving the ``dumps`` into a helper no longer hides it.  ``wire.py``
+itself is deliberately absent from the default config — its pickle
+fallback is the designed, counted escape hatch, and resolved callees
+inside it are likewise exempt."""
 
 from __future__ import annotations
 
@@ -32,8 +36,8 @@ _PICKLE_MODULES = ("pickle.", "cloudpickle.", "marshal.", "_pickle.")
 class PickleFastLane(Rule):
     name = "pickle-fast-lane"
 
-    def check(self, unit: FileUnit, config: LintConfig
-              ) -> Iterable[Finding]:
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
         pattern = None
         for sfx, rx in config.fast_lane.items():
             if unit.path.endswith(sfx):
@@ -50,15 +54,33 @@ class PickleFastLane(Rule):
             # path (done-callbacks, closures) — descend into them.
             for call in iter_body_calls(node, into_nested=True):
                 name = dotted_name(call.func)
+                msg = None
                 if name.startswith(_PICKLE_MODULES):
+                    msg = (f"{name}() inside fast-lane function "
+                           f"{node.name}() — the v2 wire path is "
+                           "zero-pickle by contract; use the T_* codec "
+                           "or route through the counted fallback")
+                elif name and index is not None:
+                    res = index.resolve_call(unit, call)
+                    if res is not None and res.is_function \
+                            and not res.unit.path.endswith("wire.py") \
+                            and self._helper_pickles(res):
+                        msg = (f"{name}() pickles in its body "
+                               f"({res.unit.path}) and is called from "
+                               f"fast-lane function {node.name}() — the "
+                               "v2 wire path is zero-pickle by contract")
+                if msg is not None:
                     yield Finding(
                         rule=self.name, path=unit.path, line=call.lineno,
-                        col=call.col_offset,
-                        message=(f"{name}() inside fast-lane function "
-                                 f"{node.name}() — the v2 wire path is "
-                                 "zero-pickle by contract; use the T_* "
-                                 "codec or route through the counted "
-                                 "fallback"),
+                        col=call.col_offset, message=msg,
                         scope=unit.scope_of(call),
                         source=unit.source_line(call.lineno),
                         end_line=getattr(call, "end_lineno", 0) or 0)
+
+    @staticmethod
+    def _helper_pickles(res) -> bool:
+        for sub in ast.walk(res.node):
+            if isinstance(sub, ast.Call) and \
+                    dotted_name(sub.func).startswith(_PICKLE_MODULES):
+                return True
+        return False

@@ -1,0 +1,187 @@
+"""Rule 9 — resource-leak (paired acquire/release on every exit path).
+
+The runtime is built on three explicitly-paired resources, each with a
+chaos test but (until now) no static check:
+
+- **KV-cache pages** — ``PageAllocator.alloc`` in the serve engine; a
+  leaked block eventually wedges admission for the whole replica;
+- **plasma buffers** — ``create``/``_create_with_spill`` allocations
+  that must reach ``seal`` (or be ``abort``/``delete``d): an unsealed
+  buffer holds store memory forever and blocks re-put of the same id;
+- **owner-side stream state** — ``register_stream`` entries that must
+  be popped/cancelled or the owner's stream map grows without bound.
+
+``config.resource_pairs`` describes each pair as alloc/release regexes
+over the full dotted call name plus the paths where *allocations* are
+scanned.  Releases are matched project-wide (via the index's unit list),
+so the cross-module shape — pages allocated by the engine's admission
+path, freed by retirement driven from the ingress — pairs up without
+same-file heuristics.
+
+Per allocation site the rule asks: where does the resource go?
+
+- **escapes** (stored to an attribute/subscript, returned, yielded, or
+  consumed directly by an enclosing expression): ownership transfers —
+  require only that *some* code in the project performs a matching
+  release;
+- **held locally / registered bare**: require a release on the error
+  path — a matching release inside an ``except`` handler or ``finally``
+  body of the same function, or allocation via ``with``.  A release
+  that only sits on the straight-line path means any exception between
+  acquire and release leaks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.tools.rtlint.engine import (Finding, FileUnit, LintConfig,
+                                         Rule, dotted_name, iter_body_calls)
+
+
+def _own_calls(fn: ast.AST) -> List[ast.Call]:
+    return list(iter_body_calls(fn))
+
+
+def _alloc_context(unit: FileUnit, call: ast.Call
+                   ) -> Tuple[str, Optional[str]]:
+    """('with'|'escape'|'local'|'bare', local var name or None)."""
+    parent = unit.parents.get(call)
+    if isinstance(parent, ast.Await):
+        parent = unit.parents.get(parent)
+    if isinstance(parent, ast.withitem):
+        return "with", None
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                return "escape", None
+        for t in targets:
+            if isinstance(t, ast.Name):
+                return "local", t.id
+        return "escape", None     # tuple unpack etc. — assume it travels
+    if isinstance(parent, ast.Expr):
+        return "bare", None
+    if isinstance(parent, ast.Return):
+        return "escape", None
+    # nested in a larger expression: the consumer owns it
+    return "escape", None
+
+
+def _var_escapes(fn: ast.AST, var: str) -> bool:
+    """The local travels beyond this frame: returned, yielded, or stored
+    into an attribute/subscript (object state released elsewhere)."""
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and n.value is not None:
+            if any(isinstance(s, ast.Name) and s.id == var
+                   for s in ast.walk(n.value)):
+                return True
+        if isinstance(n, ast.Assign):
+            stores = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                         for t in n.targets)
+            if stores and any(isinstance(s, ast.Name) and s.id == var
+                              for s in ast.walk(n.value)):
+                return True
+    return False
+
+
+def _on_error_path(fn: ast.AST, releases: List[ast.Call]) -> bool:
+    """Some matching release sits in an except handler or finally body."""
+    ids = {id(r) for r in releases}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Try):
+            regions = list(n.finalbody)
+            for h in n.handlers:
+                regions.extend(h.body)
+            for stmt in regions:
+                for sub in ast.walk(stmt):
+                    if id(sub) in ids:
+                        return True
+    return False
+
+
+class ResourceLeak(Rule):
+    name = "resource-leak"
+
+    def check(self, unit: FileUnit, config: LintConfig,
+              index=None) -> Iterable[Finding]:
+        specs = [s for s in config.resource_pairs
+                 if any(frag in unit.path for frag in s["paths"])]
+        if not specs:
+            return
+        units = index.units if index is not None else [unit]
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = _own_calls(node)
+            for spec in specs:
+                alloc_re = re.compile(str(spec["alloc"]))
+                rel_re = re.compile(str(spec["release"]))
+                allocs = [c for c in calls
+                          if alloc_re.search(dotted_name(c.func))]
+                if not allocs:
+                    continue
+                releases = [c for c in calls
+                            if rel_re.search(dotted_name(c.func))]
+                for call in allocs:
+                    f = self._check_alloc(unit, node, call, releases,
+                                          spec, units, rel_re)
+                    if f is not None:
+                        yield f
+
+    def _check_alloc(self, unit: FileUnit, fn: ast.AST, call: ast.Call,
+                     releases: List[ast.Call], spec: Dict[str, object],
+                     units: List[FileUnit],
+                     rel_re: "re.Pattern") -> Optional[Finding]:
+        what = str(spec["what"])
+        kind, var = _alloc_context(unit, call)
+        if kind == "with":
+            return None
+        if kind == "local" and var is not None and _var_escapes(fn, var):
+            kind = "escape"
+        if kind == "escape":
+            if self._project_release_exists(units, rel_re):
+                return None
+            return self._finding(
+                unit, call,
+                f"{what} allocated here escapes this function, but no "
+                f"release matching /{spec['release']}/ exists anywhere "
+                "in the linted project — nothing can ever free it")
+        # local or bare: needs an error-path release in this function
+        if not releases:
+            return self._finding(
+                unit, call,
+                f"{what} acquired here is never released in this function "
+                "and does not escape — on any exception (or even the "
+                "success path) it leaks; release in a finally/except, or "
+                "store it where the owner can reach it")
+        if not _on_error_path(fn, releases):
+            return self._finding(
+                unit, call,
+                f"{what} is released only on the straight-line path — an "
+                "exception between acquire and release leaks it; move the "
+                "release into a finally, or add an except that releases "
+                "and re-raises")
+        return None
+
+    @staticmethod
+    def _project_release_exists(units: List[FileUnit],
+                                rel_re: "re.Pattern") -> bool:
+        for u in units:
+            for n in ast.walk(u.tree):
+                if isinstance(n, ast.Call) \
+                        and rel_re.search(dotted_name(n.func)):
+                    return True
+        return False
+
+    def _finding(self, unit: FileUnit, call: ast.Call,
+                 message: str) -> Finding:
+        return Finding(rule=self.name, path=unit.path, line=call.lineno,
+                       col=call.col_offset, message=message,
+                       scope=unit.scope_of(call),
+                       source=unit.source_line(call.lineno),
+                       end_line=getattr(call, "end_lineno", 0) or 0)

@@ -140,8 +140,14 @@ class RayTrainWorker:
                 else:
                     result = train_fn()
                 q.put(("done", result, None))
+            # rtlint: disable=cancellation-safety - thread boundary: the
+            # preemption is forwarded over the result queue and re-raised
+            # driver-side by the supervisor, not swallowed.
             except Preempted as e:
                 q.put(("preempted", str(e), None))
+            # rtlint: disable=cancellation-safety - thread boundary:
+            # forwarded to the driver over the result queue; raising here
+            # would kill the train thread with no report.
             except BaseException as e:  # noqa: BLE001 - forwarded to driver
                 q.put(("error", repr(e), traceback.format_exc()))
             finally:

@@ -151,7 +151,7 @@ def serve_totals() -> Dict[str, Any]:
     dead = reply.get("dead_totals", {})
     out: Dict[str, Any] = {}
     for k in ("router_retries", "circuit_open", "streams_resumed",
-              "drain_handoffs"):
+              "drain_handoffs", "ctrl_reresolves"):
         out[k] = dead.get(k, 0) + sum(s.get(k, 0) for s in stats.values())
     try:
         agg = _gcs_request({"type": "list_metrics"}) or []

@@ -72,6 +72,8 @@ def send_event(workflow_id: str, name: str, value: Any = None) -> None:
     tmp = os.path.join(d, name + ".tmp")
     with open(tmp, "wb") as f:
         pickle.dump(value, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(d, name + ".pkl"))
 
 
@@ -128,6 +130,8 @@ def _write_meta(workflow_id: str, _only_if_status=None, **updates):
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     return meta
 
@@ -143,8 +147,13 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     import cloudpickle
     dag_path = os.path.join(wf, "dag.pkl")
     if not os.path.exists(dag_path):
+        # The DAG pickle is what resume() rebuilds from — make it durable
+        # before meta publishes RUNNING, or a crash leaves a workflow that
+        # claims to be resumable with a torn dag.pkl.
         with open(dag_path, "wb") as f:
             cloudpickle.dump((dag, args), f)
+            f.flush()
+            os.fsync(f.fileno())
     _write_meta(workflow_id, status="RUNNING", start_time=time.time(),
                 pid=os.getpid())
     try:
@@ -218,6 +227,8 @@ def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(value, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)   # atomic: a step is done iff its file exists
         resolved[id(node)] = value
     return resolved[id(dag)]

@@ -2,9 +2,13 @@
 # rtlint gate: project-native static analysis over ray_tpu/.
 # Exit 0 = clean (baselined findings are reported but don't fail).
 #
-#   scripts/run_lint.sh             # human output
-#   scripts/run_lint.sh --json      # machine output
-#   scripts/run_lint.sh --update    # rewrite the baseline (after review!)
+#   scripts/run_lint.sh                  # human output, whole tree
+#   scripts/run_lint.sh --json           # machine output
+#   scripts/run_lint.sh --changed [REF]  # only files changed vs REF
+#                                        # (default HEAD); the whole
+#                                        # tree is still indexed
+#   scripts/run_lint.sh --update         # rewrite the baseline
+#                                        # (after review!)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,6 +16,9 @@ case "${1:-}" in
   --json)
     exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint \
         --format json ray_tpu/ ;;
+  --changed)
+    exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint \
+        --changed "${2:-HEAD}" ray_tpu/ ;;
   --update)
     exec env JAX_PLATFORMS=cpu python -m ray_tpu.tools.rtlint \
         --write-baseline ray_tpu/ ;;
